@@ -1,0 +1,22 @@
+"""cess_tpu — a TPU-native storage-proof framework.
+
+A ground-up re-design of the capabilities of the CESS decentralized storage
+chain (reference: /root/reference, omahs/cess): file deals, erasure-coded
+segment/fragment accounting, miner registry + staking rewards, TEE-worker
+registry, and the PoDR2 random-challenge audit protocol — with every
+cryptographic / coding hot path (Reed-Solomon over GF(2^8), PoDR2 tag & proof
+math over the BLS12-381 scalar field, SHA-256/Merkle, BLS pairing, RSA modexp)
+implemented as batched, vmapped JAX kernels that compile to TPU, behind a
+pluggable ``ProofBackend`` with a bit-identical CPU reference.
+
+Layout (maps to SURVEY.md §7 build plan):
+  utils/     — canonical codec, hashing, deterministic protocol RNG (L0)
+  ops/       — JAX/TPU kernels + numpy references (L1)
+  proof/     — ProofBackend seam: cpu / xla implementations (L2)
+  chain/     — protocol state machines: sminer, storage-handler, file-bank,
+               tee-worker, audit, scheduler-credit, oss, cacher, staking (L3)
+               and the deterministic block loop / multi-role node sim (L4)
+  parallel/  — device-mesh sharding of verification batches (L5)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+from . import codec, hashing, rng  # noqa: F401

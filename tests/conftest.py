@@ -1,0 +1,14 @@
+"""Test harness config: run JAX on a virtual 8-device CPU mesh.
+
+Must set flags before jax imports anywhere in the test session.  Bench and the
+driver's dryrun use real TPU / their own flags; tests are CPU-deterministic.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")

@@ -1,0 +1,112 @@
+"""Tests for GF(2^8) and Reed-Solomon kernels (L1)."""
+
+import numpy as np
+import pytest
+
+from cess_tpu.ops import gf256
+from cess_tpu.ops.rs import RSCode
+
+
+class TestGF256:
+    def test_field_axioms_sampled(self):
+        rng = np.random.default_rng(0)
+        for _ in range(200):
+            a, b, c = (int(x) for x in rng.integers(0, 256, 3))
+            assert gf256.gf_mul(a, b) == gf256.gf_mul(b, a)
+            assert gf256.gf_mul(a, gf256.gf_mul(b, c)) == gf256.gf_mul(
+                gf256.gf_mul(a, b), c
+            )
+            # distributivity over XOR addition
+            assert gf256.gf_mul(a, b ^ c) == gf256.gf_mul(a, b) ^ gf256.gf_mul(a, c)
+
+    def test_pow_large_exponent(self):
+        # regression: int32 overflow in LOG[a] * n gave wrong answers
+        assert gf256.gf_pow(3, 2**28) == gf256.gf_pow(3, (2**28) % 255)
+        assert gf256.gf_pow(2, 2**40) == gf256.gf_pow(2, (2**40) % 255)
+
+    def test_inverse(self):
+        for a in range(1, 256):
+            assert gf256.gf_mul(a, gf256.gf_inv(a)) == 1
+
+    def test_mat_inv(self):
+        m = gf256.cauchy_matrix(4, 4)[:, :4]  # 4x4 Cauchy block, invertible
+        inv = gf256.mat_inv(m)
+        assert np.array_equal(gf256.mat_mul(m, inv), np.eye(4, dtype=np.uint8))
+
+    def test_cauchy_any_k_rows_invertible(self):
+        k, m = 4, 3
+        gen = gf256.encode_matrix(k, m)
+        import itertools
+
+        for rows in itertools.combinations(range(k + m), k):
+            sub = gen[list(rows)]
+            gf256.mat_inv(sub)  # must not raise
+
+    def test_bit_matrix_equiv(self):
+        # bit-matrix product mod 2 == GF(256) matrix product
+        rng = np.random.default_rng(1)
+        m = rng.integers(0, 256, (3, 5)).astype(np.uint8)
+        x = rng.integers(0, 256, (5, 17)).astype(np.uint8)
+        want = gf256.mat_mul(m, x)
+        bm = gf256.bit_matrix(m)  # (24, 40)
+        bits = np.unpackbits(x[:, None, :], axis=1, bitorder="little").reshape(40, 17)
+        got_bits = (bm.astype(np.int32) @ bits.astype(np.int32)) & 1
+        got = np.packbits(
+            got_bits.reshape(3, 8, 17).astype(np.uint8), axis=1, bitorder="little"
+        ).reshape(3, 17)
+        assert np.array_equal(got, want)
+
+
+@pytest.mark.parametrize("path", ["bitplane", "gather"])
+class TestRS:
+    def test_matches_numpy_reference(self, path):
+        rng = np.random.default_rng(2)
+        k, m, n = 12, 4, 1024
+        data = rng.integers(0, 256, (k, n)).astype(np.uint8)
+        want = gf256.rs_encode_ref(data, k, m)
+        got = np.asarray(RSCode(k, m, path=path).encode(data))
+        assert np.array_equal(got, want)
+
+    def test_roundtrip_erasures(self, path):
+        rng = np.random.default_rng(3)
+        k, m, n = 12, 4, 512
+        code = RSCode(k, m, path=path)
+        data = rng.integers(0, 256, (k, n)).astype(np.uint8)
+        parity = np.asarray(code.encode(data))
+        allsh = np.concatenate([data, parity], axis=0)
+        # kill m arbitrary shards
+        lost = {1, 5, 13, 14}
+        present = [i for i in range(k + m) if i not in lost]
+        rec = np.asarray(code.reconstruct(allsh[present], present))
+        assert np.array_equal(rec, data)
+
+    def test_segment_geometry(self, path):
+        # protocol geometry: 2 data + 1 parity per segment
+        rng = np.random.default_rng(4)
+        code = RSCode(2, 1, path=path)
+        data = rng.integers(0, 256, (2, 4096)).astype(np.uint8)
+        parity = np.asarray(code.encode(data))
+        assert parity.shape == (1, 4096)
+        # parity of RS(2,1) cauchy: recover from shards {0,2} and {1,2}
+        allsh = np.concatenate([data, parity], axis=0)
+        for lost in (0, 1):
+            present = [i for i in range(3) if i != lost]
+            rec = np.asarray(code.reconstruct(allsh[present], present))
+            assert np.array_equal(rec, data)
+
+    def test_batch(self, path):
+        rng = np.random.default_rng(5)
+        k, m, n, b = 4, 2, 128, 6
+        code = RSCode(k, m, path=path)
+        data = rng.integers(0, 256, (b, k, n)).astype(np.uint8)
+        got = np.asarray(code.encode_batch(data))
+        for i in range(b):
+            assert np.array_equal(got[i], gf256.rs_encode_ref(data[i], k, m))
+
+
+def test_paths_agree():
+    rng = np.random.default_rng(6)
+    data = rng.integers(0, 256, (12, 777)).astype(np.uint8)
+    a = np.asarray(RSCode(12, 4, path="bitplane").encode(data))
+    b = np.asarray(RSCode(12, 4, path="gather").encode(data))
+    assert np.array_equal(a, b)
