@@ -1,0 +1,8 @@
+"""Protocol state machines (SURVEY.md §7 L3-L4).
+
+Deterministic, replayable re-designs of the reference pallets
+(/root/reference/c-pallets/*): every module is a plain-Python state machine
+operating on a shared ChainState — no Substrate, no wasm — with the
+cryptographic hot paths delegated to the ProofBackend seam (cess_tpu.proof)
+so batch work runs on TPU.
+"""

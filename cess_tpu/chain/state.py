@@ -1,0 +1,156 @@
+"""Shared chain state: block clock, balances, events, delayed-call agenda.
+
+This is the replicated-state-machine substrate of the framework (SURVEY.md §2
+"replicated state machine"): one deterministic in-memory state advanced block
+by block.  It replaces frame_system + pallet-balances + pallet-scheduler from
+the reference runtime (reference: runtime/src/lib.rs:1477-1538) with the
+minimum the storage protocol needs:
+
+ * block number clock,
+ * free/reserved balance ledger with pot (pallet-id) accounts,
+ * event sink,
+ * a named delayed-call agenda reproducing the scheduler-pallet pattern the
+   file-bank deal lifecycle relies on (reference:
+   c-pallets/file-bank/src/functions.rs:165-199 schedules deal_reassign_miner
+   and calculate_end at future blocks, cancellable by name).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+from .types import AccountId, Balance, BlockNumber, DispatchError, Event, ensure
+
+MOD = "balances"
+
+
+@dataclass
+class AccountData:
+    free: Balance = 0
+    reserved: Balance = 0
+
+
+class Balances:
+    """free/reserved ledger with the Currency trait surface the pallets use."""
+
+    def __init__(self, state: "ChainState") -> None:
+        self._state = state
+        self.accounts: dict[AccountId, AccountData] = {}
+        self.total_issuance: Balance = 0
+
+    def account(self, who: AccountId) -> AccountData:
+        return self.accounts.setdefault(who, AccountData())
+
+    def free(self, who: AccountId) -> Balance:
+        return self.account(who).free
+
+    def reserved(self, who: AccountId) -> Balance:
+        return self.account(who).reserved
+
+    def mint(self, who: AccountId, amount: Balance) -> None:
+        """Genesis / reward issuance (resolve_creating in the reference)."""
+        self.account(who).free += amount
+        self.total_issuance += amount
+
+    def burn(self, who: AccountId, amount: Balance) -> None:
+        acct = self.account(who)
+        ensure(acct.free >= amount, MOD, "InsufficientBalance")
+        acct.free -= amount
+        self.total_issuance -= amount
+
+    def can_slash(self, who: AccountId, amount: Balance) -> bool:
+        return self.free(who) >= amount
+
+    def transfer(self, src: AccountId, dst: AccountId, amount: Balance) -> None:
+        ensure(amount >= 0, MOD, "NegativeTransfer")
+        a = self.account(src)
+        ensure(a.free >= amount, MOD, "InsufficientBalance")
+        a.free -= amount
+        self.account(dst).free += amount
+
+    def reserve(self, who: AccountId, amount: Balance) -> None:
+        a = self.account(who)
+        ensure(a.free >= amount, MOD, "InsufficientBalance")
+        a.free -= amount
+        a.reserved += amount
+
+    def unreserve(self, who: AccountId, amount: Balance) -> Balance:
+        """Moves up to `amount` back to free; returns what was actually moved
+        (Substrate's unreserve saturates rather than erroring)."""
+        a = self.account(who)
+        moved = min(a.reserved, amount)
+        a.reserved -= moved
+        a.free += moved
+        return moved
+
+
+@dataclass
+class ScheduledCall:
+    """A named delayed call: (pallet, method, args) dispatched as root."""
+
+    name: str
+    pallet: str
+    method: str
+    args: tuple
+
+
+class Agenda:
+    """pallet-scheduler equivalent: named calls executed at a target block."""
+
+    def __init__(self) -> None:
+        self._by_block: dict[BlockNumber, list[ScheduledCall]] = {}
+        self._names: dict[str, BlockNumber] = {}
+
+    def schedule_named(
+        self, name: str, at: BlockNumber, pallet: str, method: str, *args
+    ) -> None:
+        ensure(name not in self._names, "scheduler", "AlreadyScheduled", name)
+        self._by_block.setdefault(at, []).append(
+            ScheduledCall(name, pallet, method, args)
+        )
+        self._names[name] = at
+
+    def cancel_named(self, name: str) -> bool:
+        at = self._names.pop(name, None)
+        if at is None:
+            return False
+        self._by_block[at] = [c for c in self._by_block[at] if c.name != name]
+        return True
+
+    def take_due(self, block: BlockNumber) -> list[ScheduledCall]:
+        calls = self._by_block.pop(block, [])
+        for c in calls:
+            self._names.pop(c.name, None)
+        return calls
+
+    def is_scheduled(self, name: str) -> bool:
+        return name in self._names
+
+
+class ChainState:
+    """The one shared state object every pallet operates on."""
+
+    def __init__(self) -> None:
+        self.block_number: BlockNumber = 0
+        self.events: list[Event] = []
+        self.balances = Balances(self)
+        self.agenda = Agenda()
+        # Per-block shared randomness (parent-block randomness in the
+        # reference, supplied by RRSC — reference: runtime/src/lib.rs:1003).
+        self.randomness: bytes = bytes(32)
+
+    # -- events ---------------------------------------------------------
+
+    def deposit_event(self, pallet: str, name: str, **fields) -> None:
+        self.events.append(Event.of(pallet, name, **fields))
+
+    def events_of(self, pallet: str, name: str | None = None) -> list[Event]:
+        return [
+            e
+            for e in self.events
+            if e.pallet == pallet and (name is None or e.name == name)
+        ]
+
+    def clear_events(self) -> None:
+        self.events.clear()

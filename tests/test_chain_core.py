@@ -1,0 +1,82 @@
+"""Chain-core tests: Perbill exactness, balances, scheduler agenda."""
+
+import pytest
+
+from cess_tpu.chain.state import ChainState
+from cess_tpu.chain.types import BILLION, DispatchError, Perbill
+
+
+class TestPerbill:
+    def test_from_percent_mul_floor(self):
+        # 30% of 1001 floors to 300 (Perbill floor semantics).
+        assert Perbill.from_percent(30).mul_floor(1001) == 300
+        assert Perbill.from_percent(100).mul_floor(7) == 7
+        assert Perbill.from_percent(0).mul_floor(7) == 0
+
+    def test_from_rational_rounds_down(self):
+        # 1/3 rounds down to 333_333_333 parts per billion.
+        p = Perbill.from_rational(1, 3)
+        assert p.parts == 333_333_333
+        assert p.mul_floor(3 * BILLION) == 999_999_999
+
+    def test_from_rational_saturates(self):
+        assert Perbill.from_rational(5, 3).parts == BILLION
+        assert Perbill.from_rational(5, 0).parts == BILLION
+
+    def test_large_values_exact(self):
+        # u128-scale values stay exact (Python ints, no floats anywhere).
+        v = 2**100
+        assert Perbill.from_percent(70).mul_floor(v) == v * 700_000_000 // BILLION
+
+
+class TestBalances:
+    def test_transfer_reserve_unreserve(self):
+        s = ChainState()
+        s.balances.mint("alice", 100)
+        s.balances.transfer("alice", "bob", 30)
+        assert s.balances.free("alice") == 70
+        assert s.balances.free("bob") == 30
+        s.balances.reserve("bob", 20)
+        assert s.balances.free("bob") == 10
+        assert s.balances.reserved("bob") == 20
+        moved = s.balances.unreserve("bob", 50)
+        assert moved == 20
+        assert s.balances.free("bob") == 30
+
+    def test_insufficient_balance(self):
+        s = ChainState()
+        s.balances.mint("alice", 5)
+        with pytest.raises(DispatchError):
+            s.balances.transfer("alice", "bob", 6)
+        assert s.balances.free("alice") == 5
+
+    def test_total_issuance(self):
+        s = ChainState()
+        s.balances.mint("a", 10)
+        s.balances.mint("b", 7)
+        s.balances.burn("a", 3)
+        assert s.balances.total_issuance == 14
+
+
+class TestAgenda:
+    def test_schedule_and_fire(self):
+        s = ChainState()
+        s.agenda.schedule_named("t1", 5, "file_bank", "calculate_end", "deal")
+        assert s.agenda.is_scheduled("t1")
+        assert s.agenda.take_due(4) == []
+        due = s.agenda.take_due(5)
+        assert [c.name for c in due] == ["t1"]
+        assert not s.agenda.is_scheduled("t1")
+
+    def test_cancel(self):
+        s = ChainState()
+        s.agenda.schedule_named("t1", 5, "p", "m")
+        assert s.agenda.cancel_named("t1")
+        assert not s.agenda.cancel_named("t1")
+        assert s.agenda.take_due(5) == []
+
+    def test_duplicate_name_rejected(self):
+        s = ChainState()
+        s.agenda.schedule_named("t1", 5, "p", "m")
+        with pytest.raises(DispatchError):
+            s.agenda.schedule_named("t1", 9, "p", "m")

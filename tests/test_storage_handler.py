@@ -1,0 +1,140 @@
+"""storage-handler pallet tests — space market + ledger + expiry sweep."""
+
+import pytest
+
+from cess_tpu.chain.state import ChainState
+from cess_tpu.chain.storage_handler import (
+    FILBAK_POT,
+    SPACE_DEAD,
+    SPACE_FROZEN,
+    SPACE_NORMAL,
+    StorageHandlerPallet,
+)
+from cess_tpu.chain.types import DispatchError, G_BYTE, TOKEN
+
+ONE_DAY = 14400
+PRICE = 30 * TOKEN  # per GiB-month
+
+
+@pytest.fixture
+def env():
+    state = ChainState()
+    pallet = StorageHandlerPallet(
+        state, one_day_block=ONE_DAY, frozen_days=7, unit_price=PRICE
+    )
+    pallet.add_total_idle_space(1000 * G_BYTE)
+    state.balances.mint("u1", 100_000 * TOKEN)
+    return state, pallet
+
+
+class TestBuySpace:
+    def test_buy(self, env):
+        state, pallet = env
+        pallet.buy_space("u1", 10)
+        info = pallet.user_owned_space["u1"]
+        assert info.total_space == 10 * G_BYTE
+        assert info.remaining_space == 10 * G_BYTE
+        assert info.deadline == 30 * ONE_DAY
+        assert info.state == SPACE_NORMAL
+        assert state.balances.free(FILBAK_POT) == 10 * PRICE
+        assert pallet.purchased_space == 10 * G_BYTE
+
+    def test_rebuy_rejected(self, env):
+        _, pallet = env
+        pallet.buy_space("u1", 1)
+        with pytest.raises(DispatchError):
+            pallet.buy_space("u1", 1)
+
+    def test_cannot_oversell_network(self, env):
+        _, pallet = env
+        with pytest.raises(DispatchError):
+            pallet.buy_space("u1", 2000)  # network only holds 1000 GiB
+
+    def test_expansion_prorated_by_remaining_days(self, env):
+        state, pallet = env
+        pallet.buy_space("u1", 10)
+        state.block_number = 15 * ONE_DAY + 1  # 15 days left, rounds to 15
+        before = state.balances.free("u1")
+        pallet.expansion_space("u1", 5)
+        day_price = PRICE // 30
+        assert before - state.balances.free("u1") == day_price * 5 * 15
+        assert pallet.user_owned_space["u1"].total_space == 15 * G_BYTE
+
+    def test_renewal_extends_deadline(self, env):
+        state, pallet = env
+        pallet.buy_space("u1", 10)
+        old_deadline = pallet.user_owned_space["u1"].deadline
+        pallet.renewal_space("u1", 30)
+        assert pallet.user_owned_space["u1"].deadline == old_deadline + 30 * ONE_DAY
+        day_price = PRICE // 30
+        spent = 10 * PRICE + day_price * 10 * 30
+        assert state.balances.free("u1") == 100_000 * TOKEN - spent
+
+
+class TestLedger:
+    def test_lock_use_unlock(self, env):
+        _, pallet = env
+        pallet.buy_space("u1", 10)
+        pallet.lock_user_space("u1", 4 * G_BYTE)
+        info = pallet.user_owned_space["u1"]
+        assert info.locked_space == 4 * G_BYTE
+        assert info.remaining_space == 6 * G_BYTE
+        pallet.unlock_and_used_user_space("u1", 3 * G_BYTE)
+        pallet.unlock_user_space("u1", 1 * G_BYTE)
+        assert info.locked_space == 0
+        assert info.used_space == 3 * G_BYTE
+        assert info.remaining_space == 7 * G_BYTE
+
+    def test_update_user_space_delete_path(self, env):
+        _, pallet = env
+        pallet.buy_space("u1", 10)
+        pallet.update_user_space("u1", 1, 4 * G_BYTE)
+        pallet.update_user_space("u1", 2, 4 * G_BYTE)
+        info = pallet.user_owned_space["u1"]
+        assert info.used_space == 0
+        assert info.remaining_space == 10 * G_BYTE
+
+    def test_insufficient_storage(self, env):
+        _, pallet = env
+        pallet.buy_space("u1", 1)
+        with pytest.raises(DispatchError):
+            pallet.lock_user_space("u1", 2 * G_BYTE)
+
+    def test_global_counters(self, env):
+        _, pallet = env
+        pallet.add_total_service_space(5 * G_BYTE)
+        pallet.sub_total_idle_space(5 * G_BYTE)
+        assert pallet.total_idle_space == 995 * G_BYTE
+        assert pallet.get_total_space() == 1000 * G_BYTE
+
+
+class TestFrozenTask:
+    def test_freeze_then_dead(self, env):
+        state, pallet = env
+        pallet.buy_space("u1", 10)
+        deadline = pallet.user_owned_space["u1"].deadline
+        state.block_number = deadline + 1
+        assert pallet.frozen_task() == []
+        assert pallet.user_owned_space["u1"].state == SPACE_FROZEN
+        # Frozen leases reject new usage.
+        with pytest.raises(DispatchError):
+            pallet.lock_user_space("u1", G_BYTE)
+        state.block_number = deadline + 7 * ONE_DAY + 1
+        assert pallet.frozen_task() == ["u1"]
+        assert pallet.user_owned_space["u1"].state == SPACE_DEAD
+
+    def test_renewal_revives_frozen(self, env):
+        state, pallet = env
+        pallet.buy_space("u1", 10)
+        deadline = pallet.user_owned_space["u1"].deadline
+        state.block_number = deadline + 1
+        pallet.frozen_task()
+        pallet.renewal_space("u1", 30)
+        assert pallet.user_owned_space["u1"].state == SPACE_NORMAL
+
+    def test_delete_user_space(self, env):
+        _, pallet = env
+        pallet.buy_space("u1", 10)
+        pallet.delete_user_space_storage("u1")
+        assert pallet.purchased_space == 0
+        assert "u1" not in pallet.user_owned_space
