@@ -1,0 +1,149 @@
+"""Runtime composition + deterministic block loop.
+
+The construct_runtime! equivalent (reference: runtime/src/lib.rs:1477-1538):
+wires every pallet against the shared ChainState, binds the cross-pallet
+traits, and drives the per-block lifecycle —
+
+  block N:  advance clock → refresh shared randomness (the RRSC
+            parent-block-randomness stand-in) → on_initialize hooks
+            (audit sweeps, file-bank lease sweep, scheduler-credit period
+            roll) → dispatch due scheduler agenda calls → (extrinsics
+            applied by callers) → era rotation at era boundaries
+
+Determinism contract: given the same genesis + extrinsic sequence, every
+replica computes identical state — the replicated-state-machine property the
+reference gets from Substrate (SURVEY.md §2 parallelism item 1).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..utils.hashing import blake2b_256
+from .audit import AuditPallet
+from .cacher import CacherPallet
+from .file_bank import FileBankPallet
+from .oss import OssPallet
+from .scheduler_credit import SchedulerCreditPallet
+from .sminer import SminerPallet
+from .staking import StakingPallet
+from .state import ChainState, ScheduledCall
+from .storage_handler import StorageHandlerPallet
+from .tee_worker import TeeWorkerPallet
+from .types import BLOCKS_PER_DAY, BLOCKS_PER_HOUR, Balance, DispatchError, TOKEN
+
+
+@dataclass
+class RuntimeConfig:
+    """Genesis knobs (chain-spec equivalent, reference:
+    node/src/chain_spec.rs:84-318 + runtime parameter_types)."""
+
+    one_day_block: int = BLOCKS_PER_DAY
+    one_hour_block: int = BLOCKS_PER_HOUR
+    frozen_days: int = 7
+    space_unit_price: Balance = 30 * TOKEN      # per GiB-month
+    era_duration_blocks: int = 6 * BLOCKS_PER_HOUR
+    eras_per_year: int = 1460
+    credit_period_blocks: int = BLOCKS_PER_DAY
+    audit_lock_time: int = 10                   # LockTime (runtime lib.rs:994)
+    genesis_randomness: bytes = bytes(32)
+    endowed: dict = field(default_factory=dict)  # account -> free balance
+
+
+class Runtime:
+    def __init__(self, config: RuntimeConfig | None = None) -> None:
+        self.config = config or RuntimeConfig()
+        cfg = self.config
+        self.state = ChainState()
+        self.state.randomness = cfg.genesis_randomness
+
+        # Pallet graph, wired as the reference runtime binds the traits
+        # (runtime/src/lib.rs:944-1122).
+        self.sminer = SminerPallet(self.state, cfg.one_day_block)
+        self.storage_handler = StorageHandlerPallet(
+            self.state, cfg.one_day_block, cfg.frozen_days, cfg.space_unit_price
+        )
+        self.oss = OssPallet(self.state)
+        self.cacher = CacherPallet(self.state)
+        self.scheduler_credit = SchedulerCreditPallet(
+            self.state, cfg.credit_period_blocks
+        )
+        self.staking = StakingPallet(
+            self.state, self.sminer, eras_per_year=cfg.eras_per_year
+        )
+        self.tee_worker = TeeWorkerPallet(
+            self.state, self.staking, self.scheduler_credit
+        )
+        self.file_bank = FileBankPallet(
+            self.state,
+            self.sminer,
+            self.storage_handler,
+            tee_worker=self.tee_worker,
+            oss=self.oss,
+            one_day_block=cfg.one_day_block,
+        )
+        self.audit = AuditPallet(
+            self.state,
+            self.sminer,
+            self.file_bank,
+            self.tee_worker,
+            one_day_block=cfg.one_day_block,
+            one_hour_block=cfg.one_hour_block,
+            lock_time=cfg.audit_lock_time,
+        )
+
+        for acc, amount in cfg.endowed.items():
+            self.state.balances.mint(acc, amount)
+
+        # Root-dispatchable scheduler agenda targets.
+        self._dispatch = {
+            ("file_bank", "deal_reassign_miner"): self.file_bank.deal_reassign_miner,
+            ("file_bank", "calculate_end"): self.file_bank.calculate_end,
+            ("file_bank", "miner_exit"): self.file_bank.miner_exit,
+        }
+
+    # ------------------------------------------------------------ block loop
+
+    def _refresh_randomness(self) -> None:
+        """Per-block shared randomness — stands in for RRSC
+        ParentBlockRandomness (reference: runtime/src/lib.rs:1003)."""
+        self.state.randomness = blake2b_256(
+            b"rrsc:" + self.state.randomness
+            + self.state.block_number.to_bytes(8, "little")
+        )
+
+    def next_block(self) -> None:
+        self.state.block_number += 1
+        now = self.state.block_number
+        self._refresh_randomness()
+
+        # on_initialize order mirrors pallet index order in
+        # construct_runtime! (runtime/src/lib.rs:1529-1537).
+        self.audit.on_initialize(now)
+        self.file_bank.on_initialize(now)
+        self.scheduler_credit.on_initialize(now)
+
+        # pallet-scheduler agenda.
+        for call in self.state.agenda.take_due(now):
+            self._dispatch_scheduled(call)
+
+        # Era rotation (session/staking stand-in).
+        if now % self.config.era_duration_blocks == 0:
+            self.staking.end_era()
+
+    def _dispatch_scheduled(self, call: ScheduledCall) -> None:
+        fn = self._dispatch.get((call.pallet, call.method))
+        if fn is None:
+            return
+        try:
+            fn(*call.args)
+        except DispatchError:
+            # A failed scheduled call is dropped, as in pallet-scheduler.
+            pass
+
+    def run_to_block(self, target: int) -> None:
+        while self.state.block_number < target:
+            self.next_block()
+
+    def run_blocks(self, count: int) -> None:
+        self.run_to_block(self.state.block_number + count)

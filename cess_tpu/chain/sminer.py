@@ -191,9 +191,8 @@ class SminerPallet:
             else:
                 ok = record.last_claim_time <= 0
             if not ok:
-                self.state.deposit_event(
-                    MOD, "LessThan24Hours", last=record.last_claim_time, now=now
-                )
+                # No event on failure: a failed extrinsic must leave state —
+                # including the event stream — untouched.
                 raise DispatchError(MOD, "LessThan24Hours")
         self.state.balances.transfer(REWARD_POT, to, FAUCET_VALUE)
         self.faucet_record[to] = FaucetRecord(last_claim_time=now)
@@ -229,10 +228,18 @@ class SminerPallet:
         miner.idle_space -= decrement
 
     def add_miner_service_space(self, acc: AccountId, increment: int) -> None:
-        self._miner(acc).service_space += increment
+        # Silently no-op for deregistered miners (the reference tolerates a
+        # missing entry here so restoral completion survives a withdrawn
+        # origin miner, sminer/src/lib.rs:609-652).
+        miner = self.miner_items.get(acc)
+        if miner is None:
+            return
+        miner.service_space += increment
 
     def sub_miner_service_space(self, acc: AccountId, decrement: int) -> None:
-        miner = self._miner(acc)
+        miner = self.miner_items.get(acc)
+        if miner is None:
+            return
         if miner.state == STATE_EXIT:
             return
         ensure(miner.service_space >= decrement, MOD, "Overflow")

@@ -1,0 +1,126 @@
+"""CESS-customized staking economics: era reward pools + scheduler slashing.
+
+The reference forks pallet-staking wholesale (c-pallets/staking, 14.7k LoC);
+what CESS actually changed — and what this module re-designs — is:
+
+ * fixed first-year reward pools split validator/sminer (238.5M / 477M
+   token), decaying ×0.841 per year for 30 years, divided evenly across the
+   eras of a year (reference: c-pallets/staking/src/pallet/impls.rs:432-475,
+   runtime/src/lib.rs:586-589);
+ * the sminer share is minted into the sminer reward pot via OnUnbalanced
+   (reference: c-pallets/sminer/src/lib.rs:875-887);
+ * `slash_scheduler`: a misbehaving TEE's stash loses 5% of
+   MinValidatorBond (reference: c-pallets/staking/src/slashing.rs:693-706).
+
+NPoS election, nominations and bags-list are host-framework consensus
+machinery out of scope for the storage protocol; the bonded (stash →
+controller) registry and validator set are kept, since tee-worker
+registration and the audit quorum depend on them.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .state import ChainState
+from .types import AccountId, Balance, Perbill, TOKEN, ensure
+
+MOD = "staking"
+
+TREASURY_POT = "pot/treasury"
+
+# reference: runtime/src/lib.rs:586-589
+FIRST_YEAR_VALIDATOR_REWARDS = 238_500_000 * TOKEN
+FIRST_YEAR_SMINER_REWARDS = 477_000_000 * TOKEN
+REWARD_DECREASE_RATIO = Perbill(841_000_000)  # from_perthousand(841)
+REWARD_DECREASE_YEARS = 30
+
+
+@dataclass
+class Ledger:
+    stash: AccountId
+    controller: AccountId
+    bonded: Balance
+
+
+class StakingPallet:
+    def __init__(
+        self,
+        state: ChainState,
+        sminer,
+        eras_per_year: int = 1460,
+        min_validator_bond: Balance = 5_000 * TOKEN,
+    ) -> None:
+        self.state = state
+        self.sminer = sminer
+        self.eras_per_year = eras_per_year
+        self.min_validator_bond = min_validator_bond
+        self.bonded: dict[AccountId, AccountId] = {}  # stash -> controller
+        self.ledger: dict[AccountId, Ledger] = {}  # stash -> ledger
+        self.validators: list[AccountId] = []  # stash accounts
+        self.active_era: int = 0
+        self.eras_validator_reward: dict[int, Balance] = {}
+
+    # -- bonding ---------------------------------------------------------
+
+    def bond(self, stash: AccountId, controller: AccountId, value: Balance) -> None:
+        ensure(stash not in self.bonded, MOD, "AlreadyBonded")
+        self.state.balances.reserve(stash, value)
+        self.bonded[stash] = controller
+        self.ledger[stash] = Ledger(stash, controller, value)
+        self.state.deposit_event(MOD, "Bonded", stash=stash, amount=value)
+
+    def bonded_controller(self, stash: AccountId) -> AccountId | None:
+        return self.bonded.get(stash)
+
+    def add_validator(self, stash: AccountId) -> None:
+        ensure(stash in self.bonded, MOD, "NotStash")
+        if stash not in self.validators:
+            self.validators.append(stash)
+
+    # -- era economics ----------------------------------------------------
+
+    def rewards_in_era(self, active_era_index: int) -> tuple[Balance, Balance]:
+        """(validator_payout, sminer_payout) for one era (reference:
+        impls.rs:454-475): yearly pools decay ×0.841 for ≤30 years, then
+        flatten; each era gets 1/eras_per_year of the year's pool."""
+        year_num = min(active_era_index // self.eras_per_year, REWARD_DECREASE_YEARS)
+        validator_rewards = FIRST_YEAR_VALIDATOR_REWARDS
+        sminer_rewards = FIRST_YEAR_SMINER_REWARDS
+        for _ in range(year_num):
+            validator_rewards = REWARD_DECREASE_RATIO.mul_floor(validator_rewards)
+            sminer_rewards = REWARD_DECREASE_RATIO.mul_floor(sminer_rewards)
+        return (
+            validator_rewards // self.eras_per_year,
+            sminer_rewards // self.eras_per_year,
+        )
+
+    def end_era(self) -> None:
+        """reference: impls.rs:432-451 — record the validator pool and mint
+        the sminer pool into the sminer reward pot."""
+        validator_payout, sminer_payout = self.rewards_in_era(self.active_era)
+        self.state.deposit_event(
+            MOD,
+            "EraPaid",
+            era_index=self.active_era,
+            validator_payout=validator_payout,
+            remainder=sminer_payout,
+        )
+        self.eras_validator_reward[self.active_era] = validator_payout
+        self.sminer.on_unbalanced(sminer_payout)
+        self.active_era += 1
+
+    # -- slashing ----------------------------------------------------------
+
+    def slash_scheduler(self, stash: AccountId) -> None:
+        """5% of MinValidatorBond off the TEE's stash, to treasury
+        (reference: slashing.rs:693-706)."""
+        amount = Perbill.from_percent(5).mul_floor(self.min_validator_bond)
+        ledger = self.ledger.get(stash)
+        if ledger is None:
+            return
+        taken = min(ledger.bonded, amount)
+        ledger.bonded -= taken
+        self.state.balances.unreserve(stash, taken)
+        self.state.balances.transfer(stash, TREASURY_POT, taken)
+        self.state.deposit_event(MOD, "Slashed", staker=stash, amount=taken)

@@ -70,13 +70,18 @@ class StorageHandlerPallet:
         ensure(sender not in self.user_owned_space, MOD, "PurchasedSpace")
         space = G_BYTE * gib_count
         price = self.unit_price * gib_count
-        # add_user_purchased_space + add_purchased_space happen before the
-        # payment in the reference; order preserved for event parity.
-        self._add_user_purchased_space(sender, space, days=30)
-        self._add_purchased_space(space)
+        # Checks-first (the reference relies on #[transactional] rollback to
+        # recover from its mutate-then-check order; we must not mutate until
+        # every check has passed).
         ensure(
             self.state.balances.can_slash(sender, price), MOD, "InsufficientBalance"
         )
+        total = self.total_idle_space + self.total_service_space
+        ensure(
+            self.purchased_space + space <= total, MOD, "InsufficientAvailableSpace"
+        )
+        self._add_user_purchased_space(sender, space, days=30)
+        self._add_purchased_space(space)
         self.state.balances.transfer(sender, FILBAK_POT, price)
         self.state.deposit_event(
             MOD, "BuySpace", acc=sender, storage_capacity=space, spend=price

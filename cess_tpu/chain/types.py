@@ -89,8 +89,9 @@ class Perbill:
 
     @classmethod
     def from_rational(cls, p: int, q: int) -> "Perbill":
-        if q == 0:
-            return cls(BILLION)
+        # sp-arithmetic clamps the denominator to >=1 (so 0/0 -> 0) and
+        # saturates p/q at one.
+        q = max(q, 1)
         if p >= q:
             return cls(BILLION)
         return cls(p * BILLION // q)

@@ -1,0 +1,310 @@
+"""file-bank pallet tests: deal lifecycle, fillers, restoral, miner exit."""
+
+import pytest
+
+from cess_tpu.chain.file_bank import (
+    FILE_ACTIVE,
+    FILE_CALCULATE,
+    FillerInfo,
+    RESTORAL_ORDER_LIFE,
+    SegmentList,
+    UserBrief,
+)
+from cess_tpu.chain.runtime import Runtime, RuntimeConfig
+from cess_tpu.chain.types import (
+    DispatchError,
+    FRAGMENT_COUNT,
+    FRAGMENT_SIZE,
+    G_BYTE,
+    SEGMENT_SIZE,
+    TOKEN,
+)
+from cess_tpu.utils.hashing import Hash64
+
+MINERS = ["m1", "m2", "m3", "m4"]
+
+
+def h(tag: str) -> Hash64:
+    return Hash64.of(tag.encode())
+
+
+def make_runtime(n_miners=4, fillers_each=160, buy_gib=1):
+    cfg = RuntimeConfig(
+        endowed={
+            "user": 1_000_000 * TOKEN,
+            "tee-stash": 100_000 * TOKEN,
+            "tee-ctrl": 1_000 * TOKEN,
+            **{m: 100_000 * TOKEN for m in MINERS},
+        }
+    )
+    rt = Runtime(cfg)
+    rt.run_blocks(1)
+    # Register a TEE worker (fillers need a registered scheduler).
+    rt.staking.bond("tee-stash", "tee-ctrl", 10_000 * TOKEN)
+    rt.tee_worker.register(
+        "tee-ctrl", "tee-stash", b"node-key", b"peer", b"podr2-pk", None
+    )
+    for m in MINERS[:n_miners]:
+        rt.sminer.regnstk(m, f"{m}-ben", f"peer-{m}".encode(), 4_000 * TOKEN)
+        fillers = [
+            FillerInfo(block_num=1, miner_address=m, filler_hash=h(f"fill-{m}-{i}"))
+            for i in range(fillers_each)
+        ]
+        for chunk_start in range(0, fillers_each, 10):
+            rt.file_bank.upload_filler(
+                m, "tee-ctrl", fillers[chunk_start : chunk_start + 10]
+            )
+    if buy_gib:
+        rt.storage_handler.buy_space("user", buy_gib)
+    return rt
+
+
+def declare(rt, name="file-a", segments=2):
+    deal_info = [
+        SegmentList(
+            hash=h(f"{name}-seg{s}"),
+            fragment_list=[h(f"{name}-seg{s}-frag{f}") for f in range(FRAGMENT_COUNT)],
+        )
+        for s in range(segments)
+    ]
+    brief = UserBrief(user="user", file_name=name, bucket_name="bucket-one")
+    file_hash = h(name)
+    rt.file_bank.upload_declaration(
+        "user", file_hash, deal_info, brief, file_size=segments * SEGMENT_SIZE
+    )
+    return file_hash, deal_info, brief
+
+
+class TestDealLifecycle:
+    def test_declaration_creates_deal_and_locks_space(self):
+        rt = make_runtime()
+        file_hash, deal_info, _ = declare(rt)
+        deal = rt.file_bank.deal_map[file_hash]
+        assert deal.stage == 1
+        assert len(deal.assigned_miner) > 0
+        # User space locked: 2 segments * 24 MiB.
+        needed = rt.file_bank.cal_file_size(2)
+        assert rt.storage_handler.user_owned_space["user"].locked_space == needed
+        # Miner space locked matches assigned fragments.
+        total_locked = sum(
+            rt.sminer.miner_items[mt.miner].lock_space for mt in deal.assigned_miner
+        )
+        assert total_locked == 6 * FRAGMENT_SIZE
+        # Retry task scheduled.
+        assert rt.state.agenda.is_scheduled(str(file_hash))
+
+    def test_transfer_report_completes_stage2(self):
+        rt = make_runtime()
+        file_hash, deal_info, _ = declare(rt)
+        deal = rt.file_bank.deal_map[file_hash]
+        for mt in deal.assigned_miner:
+            rt.file_bank.transfer_report(mt.miner, [file_hash])
+        assert rt.file_bank.file[file_hash].stat == FILE_CALCULATE
+        # Fragment→miner metadata materialised for every fragment.
+        file = rt.file_bank.file[file_hash]
+        frags = [f for s in file.segment_list for f in s.fragment_list]
+        assert len(frags) == 6
+        # idle → service global counters moved.
+        needed = rt.file_bank.cal_file_size(2)
+        assert rt.storage_handler.total_service_space == needed
+        # User's locked space became used.
+        info = rt.storage_handler.user_owned_space["user"]
+        assert info.locked_space == 0
+        assert info.used_space == needed
+        # calculate_end scheduled; first task cancelled.
+        assert rt.state.agenda.is_scheduled(str(file_hash))
+
+    def test_calculate_end_activates_file(self):
+        rt = make_runtime()
+        file_hash, _, _ = declare(rt)
+        deal = rt.file_bank.deal_map[file_hash]
+        miners = [mt.miner for mt in deal.assigned_miner]
+        for m in miners:
+            rt.file_bank.transfer_report(m, [file_hash])
+        # Run until the scheduled calculate_end fires.
+        for _ in range(200):
+            if file_hash not in rt.file_bank.deal_map:
+                break
+            rt.next_block()
+        assert rt.file_bank.file[file_hash].stat == FILE_ACTIVE
+        # Locked miner space became service space.
+        for m in miners:
+            assert rt.sminer.miner_items[m].lock_space == 0
+
+    def test_dedup_second_owner(self):
+        rt = make_runtime()
+        file_hash, deal_info, _ = declare(rt)
+        deal = rt.file_bank.deal_map[file_hash]
+        for mt in deal.assigned_miner:
+            rt.file_bank.transfer_report(mt.miner, [file_hash])
+        rt.state.balances.mint("user2", 10_000 * TOKEN)
+        rt.storage_handler.buy_space("user2", 1)
+        brief2 = UserBrief(user="user2", file_name="file-a", bucket_name="b2-bucket")
+        rt.file_bank.upload_declaration(
+            "user2", file_hash, deal_info, brief2, file_size=2 * SEGMENT_SIZE
+        )
+        assert len(rt.file_bank.file[file_hash].owner) == 2
+        assert (
+            rt.storage_handler.user_owned_space["user2"].used_space
+            == rt.file_bank.cal_file_size(2)
+        )
+
+    def test_deal_reassign_then_refund_after_5(self):
+        rt = make_runtime()
+        file_hash, _, _ = declare(rt)
+        # Let every scheduled retry fire without any miner reporting.
+        for _ in range(5000):
+            if file_hash not in rt.file_bank.deal_map:
+                break
+            rt.next_block()
+        assert file_hash not in rt.file_bank.deal_map
+        # All locks released.
+        info = rt.storage_handler.user_owned_space["user"]
+        assert info.locked_space == 0
+        for m in MINERS:
+            assert rt.sminer.miner_items[m].lock_space == 0
+
+    def test_upload_needs_permission(self):
+        rt = make_runtime()
+        brief = UserBrief(user="user", file_name="fff", bucket_name="bkt-x")
+        with pytest.raises(DispatchError):
+            rt.file_bank.upload_declaration(
+                "someone-else", h("x"),
+                [SegmentList(h("s"), [h("f1"), h("f2"), h("f3")])],
+                brief, SEGMENT_SIZE,
+            )
+        # OSS authorization opens the path.
+        rt.oss.authorize("user", "someone-else")
+        rt.file_bank.upload_declaration(
+            "someone-else", h("x"),
+            [SegmentList(h("s"), [h("f1"), h("f2"), h("f3")])],
+            brief, SEGMENT_SIZE,
+        )
+
+
+class TestFillers:
+    def test_upload_filler_adds_idle_space(self):
+        rt = make_runtime(n_miners=1, fillers_each=10, buy_gib=0)
+        assert rt.sminer.miner_items["m1"].idle_space == 10 * FRAGMENT_SIZE
+        assert rt.storage_handler.total_idle_space == 10 * FRAGMENT_SIZE
+
+    def test_replace_file_report_burns_fillers(self):
+        rt = make_runtime()
+        file_hash, _, _ = declare(rt)
+        deal = rt.file_bank.deal_map[file_hash]
+        for mt in deal.assigned_miner:
+            rt.file_bank.transfer_report(mt.miner, [file_hash])
+        miner = deal.assigned_miner[0].miner
+        pending = rt.file_bank.pending_replacements[miner]
+        assert pending == len(deal.assigned_miner[0].fragment_list)
+        owned = [k[1] for k in rt.file_bank.filler_map if k[0] == miner][:pending]
+        rt.file_bank.replace_file_report(miner, owned)
+        assert rt.file_bank.pending_replacements[miner] == 0
+
+    def test_delete_filler(self):
+        rt = make_runtime(n_miners=1, fillers_each=10, buy_gib=0)
+        rt.file_bank.delete_filler("m1", h("fill-m1-0"))
+        assert rt.sminer.miner_items["m1"].idle_space == 9 * FRAGMENT_SIZE
+
+
+class TestDeletion:
+    def _stored_file(self, rt):
+        file_hash, deal_info, brief = declare(rt)
+        deal = rt.file_bank.deal_map[file_hash]
+        for mt in deal.assigned_miner:
+            rt.file_bank.transfer_report(mt.miner, [file_hash])
+        rt.file_bank.calculate_end(file_hash)
+        return file_hash
+
+    def test_delete_file_returns_space(self):
+        rt = make_runtime()
+        file_hash = self._stored_file(rt)
+        service_before = rt.storage_handler.total_service_space
+        rt.file_bank.delete_file("user", "user", [file_hash])
+        assert file_hash not in rt.file_bank.file
+        assert rt.storage_handler.user_owned_space["user"].used_space == 0
+        assert (
+            rt.storage_handler.total_service_space
+            == service_before - 6 * FRAGMENT_SIZE
+        )
+        # Miners lost the service space.
+        assert all(
+            rt.sminer.miner_items[m].service_space == 0 for m in MINERS
+        )
+
+    def test_ownership_transfer(self):
+        rt = make_runtime()
+        file_hash = self._stored_file(rt)
+        rt.state.balances.mint("user2", 100_000 * TOKEN)
+        rt.storage_handler.buy_space("user2", 1)
+        rt.file_bank.create_bucket("user2", "user2", "u2-bucket")
+        brief2 = UserBrief(user="user2", file_name="file-a", bucket_name="u2-bucket")
+        rt.file_bank.ownership_transfer("user", brief2, file_hash)
+        f = rt.file_bank.file[file_hash]
+        assert [b.user for b in f.owner] == ["user2"]
+        assert rt.storage_handler.user_owned_space["user"].used_space == 0
+
+
+class TestRestoral:
+    def _active_file(self, rt):
+        file_hash, _, _ = declare(rt)
+        deal = rt.file_bank.deal_map[file_hash]
+        for mt in deal.assigned_miner:
+            rt.file_bank.transfer_report(mt.miner, [file_hash])
+        rt.file_bank.calculate_end(file_hash)
+        return file_hash
+
+    def test_restoral_order_flow(self):
+        rt = make_runtime()
+        file_hash = self._active_file(rt)
+        f = rt.file_bank.file[file_hash]
+        frag = f.segment_list[0].fragment_list[0]
+        loser, fragment_hash = frag.miner, frag.hash
+        rt.file_bank.generate_restoral_order(loser, file_hash, fragment_hash)
+        assert not frag.avail
+        # Another positive miner claims and completes.
+        claimer = next(m for m in MINERS if m != loser)
+        rt.file_bank.claim_restoral_order(claimer, fragment_hash)
+        service_before = rt.sminer.miner_items[loser].service_space
+        rt.file_bank.restoral_order_complete(claimer, fragment_hash)
+        assert frag.avail and frag.miner == claimer
+        assert (
+            rt.sminer.miner_items[loser].service_space
+            == service_before - FRAGMENT_SIZE
+        )
+        assert fragment_hash not in rt.file_bank.restoral_order
+
+    def test_restoral_completion_after_deadline_rejected(self):
+        rt = make_runtime()
+        file_hash = self._active_file(rt)
+        f = rt.file_bank.file[file_hash]
+        frag = f.segment_list[0].fragment_list[0]
+        rt.file_bank.generate_restoral_order(frag.miner, file_hash, frag.hash)
+        claimer = next(m for m in MINERS if m != frag.miner)
+        rt.file_bank.claim_restoral_order(claimer, frag.hash)
+        rt.state.block_number += RESTORAL_ORDER_LIFE + 1
+        with pytest.raises(DispatchError):
+            rt.file_bank.restoral_order_complete(claimer, frag.hash)
+
+
+class TestMinerExit:
+    def test_exit_prep_schedules_exit(self):
+        rt = make_runtime(n_miners=4)
+        rt.file_bank.miner_exit_prep("m4")
+        assert rt.sminer.miner_items["m4"].state == "lock"
+        idle = rt.sminer.miner_items["m4"].idle_space
+        total_idle_before = rt.storage_handler.total_idle_space
+        rt.run_blocks(rt.config.one_day_block + 1)
+        assert rt.sminer.miner_items["m4"].state == "exit"
+        assert rt.storage_handler.total_idle_space == total_idle_before - idle
+        assert "m4" in rt.file_bank.restoral_target
+
+    def test_withdraw_after_cooldown(self):
+        rt = make_runtime(n_miners=4)
+        rt.file_bank.miner_exit_prep("m4")
+        rt.run_blocks(rt.config.one_day_block + 1)
+        info = rt.file_bank.restoral_target["m4"]
+        rt.state.block_number = info.cooling_block + 1
+        rt.file_bank.miner_withdraw("m4")
+        assert "m4" not in rt.sminer.miner_items
+        assert rt.state.balances.reserved("m4") == 0
