@@ -1,0 +1,193 @@
+"""BLS12-381 reference implementation tests.
+
+Validation strategy (in lieu of external KATs, which need the RFC 9380
+isogeny constants): parameter identities are asserted at import; here we
+check field axioms, curve/subgroup laws, pairing bilinearity (which an
+incorrect pairing cannot satisfy across random scalars), serialization
+round-trips against malleability, and the signature scheme end-to-end —
+mirroring the reference's test axes (reference:
+utils/verify-bls-signatures/tests/tests.rs: valid/invalid/short-sig/
+short-key vectors)."""
+
+import pytest
+
+from cess_tpu.ops import bls12_381 as bls
+from cess_tpu.ops.bls12_381 import (
+    FQ2_ONE,
+    FQ12_ONE,
+    Fq2,
+    G1Point,
+    G2Point,
+    G1_GENERATOR,
+    G2_GENERATOR,
+    P,
+    R,
+)
+
+
+class TestFields:
+    def test_fq2_mul_inverse(self):
+        a = Fq2(12345678901234567890, 98765432109876543210)
+        assert a * a.inv() == FQ2_ONE
+
+    def test_fq2_nonresidue_u(self):
+        # u^2 = -1
+        u = Fq2(0, 1)
+        assert u * u == Fq2(P - 1, 0)
+
+    def test_fq2_sqrt_roundtrip(self):
+        a = Fq2(3141592653589793, 2718281828459045)
+        sq = a.square()
+        root = sq.sqrt()
+        assert root is not None
+        assert root.square() == sq
+
+    def test_fq2_nonsquare_returns_none(self):
+        # ξ = u+1 is a non-residue in Fp2 (that's why it's the twist const).
+        assert bls.XI.sqrt() is None
+
+    def test_fq12_mul_inverse(self):
+        x = bls.FQ12_W + bls.Fq12.from_int(7)
+        assert x * x.inv() == FQ12_ONE
+
+    def test_fq12_frobenius_conjugate(self):
+        x = bls.FQ12_W * 3 + bls.Fq12.from_int(11)
+        assert x.conjugate().conjugate() == x
+        # conj is the p^6 power map
+        assert x.conjugate() == x.pow(P**6)
+
+
+class TestCurves:
+    def test_generators_have_order_r(self):
+        # _mul_raw: .mul() reduces scalars mod r, which would make this
+        # assertion vacuous.
+        assert G1_GENERATOR._mul_raw(R).is_infinity()
+        assert G2_GENERATOR._mul_raw(R).is_infinity()
+        assert not G1_GENERATOR.mul(R - 1).is_infinity()
+
+    def test_group_law_assoc(self):
+        a, b, c = G1_GENERATOR.mul(3), G1_GENERATOR.mul(11), G1_GENERATOR.mul(100)
+        assert (a + b) + c == a + (b + c)
+        assert a + (-a) == G1Point.infinity()
+
+    def test_scalar_mul_distributes(self):
+        assert G1_GENERATOR.mul(7) + G1_GENERATOR.mul(13) == G1_GENERATOR.mul(20)
+        assert G2_GENERATOR.mul(7) + G2_GENERATOR.mul(13) == G2_GENERATOR.mul(20)
+
+    def test_g1_serialization_roundtrip(self):
+        for k in (1, 2, 12345, R - 1):
+            p = G1_GENERATOR.mul(k)
+            assert G1Point.from_bytes(p.to_bytes()) == p
+        inf = G1Point.infinity()
+        assert G1Point.from_bytes(inf.to_bytes()).is_infinity()
+
+    def test_g2_serialization_roundtrip(self):
+        for k in (1, 7, 98765):
+            q = G2_GENERATOR.mul(k)
+            assert G2Point.from_bytes(q.to_bytes()) == q
+
+    def test_g1_rejects_garbage(self):
+        with pytest.raises(ValueError):
+            G1Point.from_bytes(b"\x00" * 48)  # no compression bit
+        with pytest.raises(ValueError):
+            G1Point.from_bytes(b"\x01" * 47)  # short (reference KAT axis)
+
+    def test_g1_rejects_non_subgroup(self):
+        # Find a curve point outside G1 (cofactor > 1 so they exist).
+        x = 1
+        while True:
+            y = bls.fp_sqrt((x**3 + 4) % P)
+            if y is not None:
+                cand = G1Point(x, y)
+                if not cand.in_subgroup():
+                    break
+            x += 1
+        raw = bytearray(cand.x.to_bytes(48, "big"))
+        raw[0] |= 0x80
+        if cand.y > P - cand.y:
+            raw[0] |= 0x20
+        with pytest.raises(ValueError):
+            G1Point.from_bytes(bytes(raw))
+
+
+class TestPairing:
+    def test_bilinearity(self):
+        e = bls.pairing(G1_GENERATOR.mul(5), G2_GENERATOR.mul(7))
+        assert e == bls.pairing(G1_GENERATOR, G2_GENERATOR).pow(35)
+        assert e == bls.pairing(G1_GENERATOR.mul(35), G2_GENERATOR)
+        assert e == bls.pairing(G1_GENERATOR.mul(7), G2_GENERATOR.mul(5))
+
+    def test_nondegenerate(self):
+        assert not bls.pairing(G1_GENERATOR, G2_GENERATOR).is_one()
+
+    def test_inverse_pairs_cancel(self):
+        p, q = G1_GENERATOR.mul(9), G2_GENERATOR.mul(4)
+        assert bls.pairing_check([(p, q), (-p, q)])
+        assert bls.pairing_check([(p, q), (p, -q)])
+
+    def test_infinity_pairs_to_one(self):
+        assert bls.pairing(G1Point.infinity(), G2_GENERATOR).is_one()
+
+    def test_output_has_order_r(self):
+        e = bls.pairing(G1_GENERATOR, G2_GENERATOR)
+        assert e.pow(R).is_one()
+
+
+class TestHashToG1:
+    def test_deterministic_and_in_subgroup(self):
+        p1 = bls.hash_to_g1(b"message")
+        p2 = bls.hash_to_g1(b"message")
+        assert p1 == p2
+        assert p1.in_subgroup()
+
+    def test_distinct_messages_distinct_points(self):
+        assert bls.hash_to_g1(b"a") != bls.hash_to_g1(b"b")
+
+    def test_domain_separation(self):
+        assert bls.hash_to_g1(b"m", b"DST-ONE") != bls.hash_to_g1(b"m", b"DST-TWO")
+
+    def test_expand_message_xmd_rfc_vector(self):
+        # RFC 9380 K.1 (SHA-256, DST "QUUX-V01-CS02-with-expander-SHA256-128"):
+        # expand_message_xmd("", 0x20) =
+        #   68a985b87eb6b46952128911f2a4412bbc302a9d759667f87f7a21d803f07235
+        out = bls.expand_message_xmd(
+            b"", b"QUUX-V01-CS02-with-expander-SHA256-128", 32
+        )
+        assert out.hex() == (
+            "68a985b87eb6b46952128911f2a4412bbc302a9d759667f87f7a21d803f07235"
+        )
+
+    def test_expand_message_xmd_abc_vector(self):
+        # RFC 9380 K.1: msg="abc", len=0x20 →
+        #   d8ccab23b5985ccea865c6c97b6e5b8350e794e603b4b97902f53a8a0d605615
+        out = bls.expand_message_xmd(
+            b"abc", b"QUUX-V01-CS02-with-expander-SHA256-128", 32
+        )
+        assert out.hex() == (
+            "d8ccab23b5985ccea865c6c97b6e5b8350e794e603b4b97902f53a8a0d605615"
+        )
+
+
+class TestSignatures:
+    def test_sign_verify_roundtrip(self):
+        sk = bls.keygen(b"seed-1")
+        pk = bls.sk_to_pk(sk)
+        sig = bls.sign(sk, b"the message")
+        assert bls.verify(pk, b"the message", sig)
+
+    def test_wrong_message_rejected(self):
+        sk = bls.keygen(b"seed-1")
+        assert not bls.verify(bls.sk_to_pk(sk), b"other", bls.sign(sk, b"msg"))
+
+    def test_wrong_key_rejected(self):
+        sk1, sk2 = bls.keygen(b"a"), bls.keygen(b"b")
+        sig = bls.sign(sk1, b"msg")
+        assert not bls.verify(bls.sk_to_pk(sk2), b"msg", sig)
+
+    def test_malformed_inputs_rejected(self):
+        sk = bls.keygen(b"s")
+        pk = bls.sk_to_pk(sk)
+        sig = bls.sign(sk, b"m")
+        assert not bls.verify(pk, b"m", sig[:-1])       # short sig
+        assert not bls.verify(pk[:-1], b"m", sig)       # short key
+        assert not bls.verify(pk, b"m", b"\x00" * 48)   # invalid point
