@@ -136,6 +136,7 @@ class AuditPallet:
         one_hour_block: int = 600,
         lock_time: int = 10,
         result_verifier: Callable | None = None,
+        chunk_count: int = CHUNK_COUNT,
     ) -> None:
         self.state = state
         self.sminer = sminer
@@ -144,6 +145,9 @@ class AuditPallet:
         self.one_day_block = one_day_block
         self.one_hour_block = one_hour_block
         self.lock_time = lock_time
+        # Scheme geometry: chunks per fragment (protocol value 1024,
+        # reference primitives/common/src/lib.rs:62; scaled down in sims).
+        self.chunk_count = chunk_count
         # verify(tee_node_key, message, signature) -> bool for
         # submit_verify_result; None disables (test mode).
         self.result_verifier = result_verifier
@@ -513,12 +517,13 @@ class AuditPallet:
                 if len(miner_list) > CHALLENGE_MINER_MAX:
                     raise DispatchError(MOD, "GenerateInfoError")
 
-        need_count = CHUNK_COUNT * 46 // 1000  # = 47
+        # 46/1000 density: 47 of 1024 (reference: audit/src/lib.rs:906).
+        need_count = max(1, self.chunk_count * 46 // 1000)
         random_index_list: list[int] = []
         seed = 0
         while len(random_index_list) < need_count:
             seed += 1
-            random_index = self.random_number(seed) % CHUNK_COUNT
+            random_index = self.random_number(seed) % self.chunk_count
             if random_index not in random_index_list:
                 random_index_list.append(random_index)
 

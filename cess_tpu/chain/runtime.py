@@ -46,6 +46,7 @@ class RuntimeConfig:
     eras_per_year: int = 1460
     credit_period_blocks: int = BLOCKS_PER_DAY
     audit_lock_time: int = 10                   # LockTime (runtime lib.rs:994)
+    podr2_chunk_count: int = 1024               # CHUNK_COUNT (common lib.rs:62)
     genesis_randomness: bytes = bytes(32)
     endowed: dict = field(default_factory=dict)  # account -> free balance
 
@@ -90,6 +91,7 @@ class Runtime:
             one_day_block=cfg.one_day_block,
             one_hour_block=cfg.one_hour_block,
             lock_time=cfg.audit_lock_time,
+            chunk_count=cfg.podr2_chunk_count,
         )
 
         for acc, amount in cfg.endowed.items():

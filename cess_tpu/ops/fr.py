@@ -1,0 +1,292 @@
+"""Batched BLS12-381 scalar-field (Fr, r = 255-bit) linear algebra on TPU.
+
+The PoDR2 pipeline's data-heavy arithmetic is all of one shape — "contract a
+big array of field elements against a vector of coefficients, mod r":
+
+ * prove:          μ_j  = Σ_c v_c · m_{c,j}    (ops/podr2.py prove())
+ * batch combine:  e_j  = Σ_b ρ_b · μ_{b,j}   (ops/podr2.py batch_verify())
+
+Both are integer matrix products.  The TPU has no native big-int type, so
+elements are decomposed into base-128 limbs stored as int8 — 7-bit limbs
+keep every partial product and a 47-term accumulation inside int32, and int8
+operands let XLA route the contraction through the MXU
+(`preferred_element_type=int32`).  The pipeline per call:
+
+  1. T[..., i, j] = Σ_k w[k, i] · v[..., k, j]     (int8×int8→int32 matmul)
+  2. fold the (i, j) outer-product limbs onto the anti-diagonals i+j
+     (a 0/1 tensor contraction — also a matmul)
+  3. carry-normalize to base-128
+  4. fold high limbs with a 2^(7k) mod r table until 37 limbs remain
+  5. conditional subtractions → canonical representative < r
+
+Bit-identical to Python `(sum(w*v) % R)` — asserted in tests — which is what
+lets the xla ProofBackend agree with the CPU reference byte for byte.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+R = 0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001
+
+LIMB_BITS = 7
+BASE = 1 << LIMB_BITS
+NLIMBS = (255 + LIMB_BITS - 1) // LIMB_BITS  # 37 limbs for an Fr element
+
+
+# ---------------------------------------------------------------- host codec
+
+
+def int_to_limbs(x: int, n: int) -> np.ndarray:
+    if x < 0 or x >> (LIMB_BITS * n):
+        raise ValueError(f"{x} does not fit in {n} base-128 limbs")
+    out = np.zeros(n, dtype=np.int8)
+    for i in range(n):
+        out[i] = x & (BASE - 1)
+        x >>= LIMB_BITS
+    return out
+
+
+def ints_to_limbs(xs, n: int) -> np.ndarray:
+    """Iterable of ints -> (len, n) int8 little-endian limb array."""
+    return np.stack([int_to_limbs(int(x), n) for x in xs])
+
+
+def limbs_to_int(limbs) -> int:
+    x = 0
+    for i, limb in enumerate(np.asarray(limbs).astype(np.int64).tolist()):
+        x += int(limb) << (LIMB_BITS * i)
+    return x
+
+
+def limbs_to_ints(arr) -> list[int]:
+    """(..., n) limb array -> flat list of ints over the leading axes."""
+    a = np.asarray(arr)
+    flat = a.reshape(-1, a.shape[-1])
+    return [limbs_to_int(row) for row in flat]
+
+
+@lru_cache(maxsize=None)
+def _fold_matrix(li: int, lj: int) -> np.ndarray:
+    """(li, lj, li+lj-1) one-hot: out[i, j, i+j] = 1 — maps the outer-product
+    limb grid onto anti-diagonals (polynomial multiplication)."""
+    out = np.zeros((li, lj, li + lj - 1), dtype=np.int8)
+    for i in range(li):
+        for j in range(lj):
+            out[i, j, i + j] = 1
+    return out
+
+
+@lru_cache(maxsize=None)
+def _pow_table(start: int, count: int) -> np.ndarray:
+    """(count, NLIMBS) limbs of 2^(7k) mod r for k = start..start+count-1."""
+    return ints_to_limbs(
+        [pow(2, LIMB_BITS * k, R) for k in range(start, start + count)], NLIMBS
+    )
+
+
+_R_LIMBS = None
+
+
+def _r_limbs() -> np.ndarray:
+    global _R_LIMBS
+    if _R_LIMBS is None:
+        _R_LIMBS = int_to_limbs(R, NLIMBS).astype(np.int32)
+    return _R_LIMBS
+
+
+# ---------------------------------------------------------------- device ops
+
+
+def _carry_pass(x: jnp.ndarray) -> jnp.ndarray:
+    """One base-128 carry propagation pass (length preserved; the caller
+    pads so the top carry is always zero)."""
+    low = x & (BASE - 1)
+    carry = x >> LIMB_BITS
+    return low + jnp.pad(carry[..., :-1], [(0, 0)] * (x.ndim - 1) + [(1, 0)])
+
+
+def _normalize(x: jnp.ndarray, passes: int = 6) -> jnp.ndarray:
+    """Carry-normalize int32 limbs (each < 2^31) to canonical base-128.
+    Values ≤ 2^31 need ≤ ceil(24/7)+2 = 6 passes to quiesce."""
+    for _ in range(passes):
+        x = _carry_pass(x)
+    return x
+
+
+def _cond_sub_r(x: jnp.ndarray) -> jnp.ndarray:
+    """x (…, L) normalized limbs → where(x >= r, x - r, x)."""
+    length = x.shape[-1]
+    r = np.zeros(length, dtype=np.int32)
+    r[:NLIMBS] = _r_limbs()
+    diff = x - jnp.asarray(r)
+    # Propagate borrows (static unrolled chain).
+    borrow = jnp.zeros(x.shape[:-1], dtype=jnp.int32)
+    outs = []
+    for i in range(length):
+        d = diff[..., i] - borrow
+        borrow = (d < 0).astype(jnp.int32)
+        outs.append(d + borrow * BASE)
+    sub = jnp.stack(outs, axis=-1)
+    ge = borrow == 0  # no final borrow ⇒ x >= r
+    return jnp.where(ge[..., None], sub, x)
+
+
+def _fold_once(x: jnp.ndarray) -> jnp.ndarray:
+    """One fold of limbs ≥ NLIMBS through the 2^(7k) mod r table; returns a
+    normalized (…, NLIMBS+2) array congruent to x mod r."""
+    pad_spec = [(0, 0)] * (x.ndim - 1)
+    low, high = x[..., :NLIMBS], x[..., NLIMBS:]
+    if high.shape[-1] == 0:
+        return _normalize(jnp.pad(x, pad_spec + [(0, 2)]))
+    table = jnp.asarray(_pow_table(NLIMBS, high.shape[-1]).astype(np.int32))
+    folded = jax.lax.dot_general(
+        high.astype(jnp.int32),
+        table,
+        (((high.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return _normalize(jnp.pad(low + folded, pad_spec + [(0, 2)]))
+
+
+def _fold_to_canonical(x: jnp.ndarray) -> jnp.ndarray:
+    """Normalized limbs of any length → canonical NLIMBS representative.
+
+    Convergence (static, no data-dependent shapes): the first fold brings
+    any ≤64-limb value under 2^259 + 27·128·r < 3500·r (39 limbs); each
+    subsequent fold of the 2 surplus limbs shrinks the bound — < 272·r,
+    < 34·r, < 20·r — so after four folds 20 conditional subtractions
+    finish the job.
+    """
+    x = _fold_once(x)          # → NLIMBS+2 limbs
+    for _ in range(3):
+        x = _fold_once(x[..., : NLIMBS + 2])
+    x = x[..., : NLIMBS + 2]
+    for _ in range(20):
+        x = _cond_sub_r(x)
+    # canonical < r < 2^255 ⇒ limbs ≥ NLIMBS are provably zero.
+    return x[..., :NLIMBS]
+
+
+# int32 accumulator headroom: each anti-diagonal sums ≤ min(Lw,Lv) products
+# of two 7-bit limbs over K terms; with Lw ≤ 36 that caps K at
+# 2^31 / (127·127·36) ≈ 3698.  Chunk above a conservative bound.
+SAFE_CONTRACTION = 2048
+
+
+def weighted_sum_kernel(
+    w: jnp.ndarray, v: jnp.ndarray
+) -> jnp.ndarray:
+    """Σ_k w[k] · v[..., k, :] mod r.
+
+    w: (K, Lw) int8 limbs; v: (..., K, Lv) int8 limbs.
+    Returns (..., NLIMBS) int32 canonical limbs.
+
+    Arbitrary K: contractions beyond SAFE_CONTRACTION are split into
+    statically-shaped chunks whose canonical partials are summed and
+    re-reduced — overflow-safe for any batch size.
+    """
+    k = w.shape[0]
+    if k > SAFE_CONTRACTION:
+        partials = []
+        for start in range(0, k, SAFE_CONTRACTION):
+            stop = min(start + SAFE_CONTRACTION, k)
+            partials.append(
+                _weighted_sum_unchunked(
+                    w[start:stop],
+                    jax.lax.slice_in_dim(v, start, stop, axis=v.ndim - 2),
+                )
+            )
+        # ≤ ceil(K/2048) canonical values: limbs ≤ 127·m, value < m·r —
+        # well inside _fold_to_canonical's convergence bound.
+        total = partials[0]
+        for p in partials[1:]:
+            total = total + p
+        total = _normalize(
+            jnp.pad(total, [(0, 0)] * (total.ndim - 1) + [(0, 3)])
+        )
+        return _fold_to_canonical(total)
+    return _weighted_sum_unchunked(w, v)
+
+
+def _weighted_sum_unchunked(
+    w: jnp.ndarray, v: jnp.ndarray
+) -> jnp.ndarray:
+    k_axis_w, k_axis_v = 0, v.ndim - 2
+    # 1+2: contraction over K and anti-diagonal fold — both matmuls.
+    t = jax.lax.dot_general(
+        v.astype(jnp.int8),
+        w.astype(jnp.int8),
+        (((k_axis_v,), (k_axis_w,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )  # (..., Lv, Lw)
+    fold = jnp.asarray(
+        _fold_matrix(t.shape[-2], t.shape[-1]).astype(np.int32)
+    ).reshape(t.shape[-2] * t.shape[-1], -1)
+    prod = jax.lax.dot_general(
+        t.reshape(*t.shape[:-2], -1),
+        fold,
+        (((t.ndim - 2,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )  # (..., Lv+Lw-1)
+    # 3: carries (pad for growth), 4+5: fold mod r and canonicalize.
+    prod = _normalize(jnp.pad(prod, [(0, 0)] * (prod.ndim - 1) + [(0, 5)]))
+    return _fold_to_canonical(prod)
+
+
+weighted_sum_jit = jax.jit(weighted_sum_kernel)
+
+
+# ---------------------------------------------------------------- public API
+
+
+def _limb_width(max_value: int) -> int:
+    return (max_value.bit_length() + LIMB_BITS - 1) // LIMB_BITS
+
+
+def mu_aggregate(
+    coefficients: list[int], sector_limbs: np.ndarray
+) -> np.ndarray:
+    """Batched PoDR2 μ: coefficients (the challenge's 20-byte randoms, one
+    per challenged chunk) against sector limb arrays.
+
+    sector_limbs: (..., C, S, Lm) int8 — challenged-chunk sector limbs.
+    Returns (..., S, NLIMBS) canonical int32 limbs of μ.
+    """
+    lw = max(1, _limb_width((1 << 160) - 1))
+    w = ints_to_limbs(coefficients, lw)
+    # Move C next to last for the kernel: (..., S, C, Lm)
+    v = np.moveaxis(np.asarray(sector_limbs), -3, -2)
+    return np.asarray(weighted_sum_jit(jnp.asarray(w), jnp.asarray(v)))
+
+
+def combine_mu(rhos: list[int], mu_limbs: np.ndarray) -> np.ndarray:
+    """Batch-verification combine: Σ_b ρ_b·μ_b per sector column.
+
+    mu_limbs: (B, S, Lm) int8 limbs.  Returns (S, NLIMBS) int32 limbs.
+    """
+    lw = max(1, _limb_width(max(rhos)))
+    w = ints_to_limbs(rhos, lw)
+    v = np.moveaxis(np.asarray(mu_limbs), 0, -2)  # (S, B, Lm)
+    return np.asarray(weighted_sum_jit(jnp.asarray(w), jnp.asarray(v)))
+
+
+def sectors_to_limbs(matrix: list[list[int]]) -> np.ndarray:
+    """PoDR2 sector matrix (n × s ints < 2^248) → (n, s, 36) int8 limbs."""
+    n = len(matrix)
+    s = len(matrix[0])
+    lm = _limb_width((1 << 248) - 1)
+    out = np.zeros((n, s, lm), dtype=np.int8)
+    for i, row in enumerate(matrix):
+        for j, m in enumerate(row):
+            out[i, j] = int_to_limbs(m, lm)
+    return out
+
+
+def fr_to_limbs(values: list[int]) -> np.ndarray:
+    """Canonical Fr values → (len, NLIMBS) int8 limbs."""
+    return ints_to_limbs(values, NLIMBS)

@@ -1,0 +1,14 @@
+"""Device-mesh scale-out (SURVEY.md §7 L5).
+
+The reference's scaling axes are validator-parallel challenge work with
+quorum aggregation and hash-scattered verification queues (SURVEY.md §2
+"parallelism strategies") over libp2p.  The TPU-native equivalents here
+shard the audit round's proof batch across a `jax.sharding.Mesh` with
+`shard_map`, reducing verdict material with XLA collectives (`psum`) over
+ICI — the role NCCL/MPI would play in a GPU framework, with no host-side
+gather in the loop.
+"""
+
+from .verify import audit_data_plane_step, combine_mu_sharded, make_mesh
+
+__all__ = ["audit_data_plane_step", "combine_mu_sharded", "make_mesh"]

@@ -1,0 +1,108 @@
+"""Sharded audit-round data plane: shard_map over the proof batch.
+
+One audit round's device work (xla ProofBackend, cess_tpu/proof/xla_backend)
+at multi-chip scale:
+
+  stage 1 (μ):       every proof's μ_j = Σ_c v_c·m_{c,j} — batch-sharded,
+                     embarrassingly parallel, no collectives;
+  stage 2 (combine): e_j = Σ_b ρ_b·μ_{b,j} — each device combines its local
+                     batch shard, then one `psum` over the mesh adds the
+                     per-device partial limb vectors (the verdict-aggregate
+                     reduction; the analog of the reference's 2/3-quorum
+                     aggregation of identical challenge votes, reference:
+                     c-pallets/audit/src/lib.rs:380-399).
+
+The psum'd partials are re-canonicalized on device, so the sharded result is
+bit-identical to the single-device kernel — asserted in tests on a virtual
+8-device CPU mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops import fr
+
+BATCH_AXIS = "proofs"
+
+
+def make_mesh(n_devices: int | None = None) -> Mesh:
+    """1-D mesh over the proof-batch axis.  Verification is a bag of
+    independent proofs + one reduction, so the natural layout is pure batch
+    ("dp-like") sharding with the reduction riding ICI."""
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    mesh_devices = mesh_utils.create_device_mesh((n,), devices=devices[:n])
+    return Mesh(mesh_devices, (BATCH_AXIS,))
+
+
+def _combine_local(w: jnp.ndarray, mu: jnp.ndarray) -> jnp.ndarray:
+    """Local shard combine + cross-device sum + re-canonicalize."""
+    part = fr.weighted_sum_kernel(w, jnp.moveaxis(mu, 0, -2))  # (S, 37)
+    total = jax.lax.psum(part, BATCH_AXIS)  # limbs ≤ 127 · n_devices
+    total = fr._normalize(
+        jnp.pad(total, [(0, 0)] * (total.ndim - 1) + [(0, 3)])
+    )
+    return fr._fold_to_canonical(total)
+
+
+def combine_mu_sharded(
+    mesh: Mesh, rho_limbs: np.ndarray, mu_limbs: np.ndarray
+) -> np.ndarray:
+    """Σ_b ρ_b·μ_b mod r with the batch axis sharded over the mesh.
+
+    rho_limbs: (B, Lw) int8;  mu_limbs: (B, S, Lm) int8.
+    B must divide by mesh size (pad with ρ=0 rows host-side).
+    Returns (S, NLIMBS) canonical int32 limbs, identical on every device.
+    """
+    fn = shard_map(
+        _combine_local,
+        mesh=mesh,
+        in_specs=(P(BATCH_AXIS, None), P(BATCH_AXIS, None, None)),
+        out_specs=P(None, None),
+        check_rep=False,
+    )
+    return np.asarray(jax.jit(fn)(jnp.asarray(rho_limbs), jnp.asarray(mu_limbs)))
+
+
+def _audit_step_local(
+    v: jnp.ndarray, sectors: jnp.ndarray, rho: jnp.ndarray
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """One device's full audit data-plane step over its batch shard:
+    μ for each local proof, then the psum'd batch combination."""
+    # sectors: (b_local, C, S, Lm) → μ (b_local, S, 37)
+    mu = fr.weighted_sum_kernel(v, jnp.moveaxis(sectors, 1, -2))
+    # combine: contract local batch with local ρ then psum partials.
+    mu8 = mu.astype(jnp.int8)  # canonical limbs < 128 ⇒ exact in int8
+    part = fr.weighted_sum_kernel(rho, jnp.moveaxis(mu8, 0, -2))  # (S, 37)
+    total = jax.lax.psum(part, BATCH_AXIS)
+    total = fr._normalize(
+        jnp.pad(total, [(0, 0)] * (total.ndim - 1) + [(0, 3)])
+    )
+    return mu, fr._fold_to_canonical(total)
+
+
+def audit_data_plane_step(mesh: Mesh):
+    """Build the jitted multi-chip audit step.
+
+    Returns fn(v_limbs (C, Lv), sector_limbs (B, C, S, Lm) [sharded on B],
+    rho_limbs (B, Lw) [sharded on B]) → (μ (B, S, 37) [sharded on B],
+    combined (S, 37) [replicated]).
+    """
+    fn = shard_map(
+        _audit_step_local,
+        mesh=mesh,
+        in_specs=(
+            P(None, None),
+            P(BATCH_AXIS, None, None, None),
+            P(BATCH_AXIS, None),
+        ),
+        out_specs=(P(BATCH_AXIS, None, None), P(None, None)),
+        check_rep=False,
+    )
+    return jax.jit(fn)

@@ -1,0 +1,35 @@
+"""ProofBackend seam (SURVEY.md §7 L2).
+
+The reference leaves proof verification as a declared TODO at the chain
+boundary (reference: c-pallets/audit/src/lib.rs:484 "TODO! Podr2Key verify")
+and runs the real PoDR2 math in external TEE tooling.  This package is that
+seam made explicit: a backend interface with
+
+  * cpu  — pure-host reference (ops/podr2.py), the bit-exactness anchor;
+  * xla  — the TPU path: μ aggregation / batch combination as MXU limb
+           matmuls (ops/fr.py), G1/pairing work host-side pending the
+           ops/g1.py device kernels.
+
+Both produce identical verdict bitmaps for identical inputs.
+"""
+
+from .backend import ProofBackend, VerifyItem
+from .cpu_backend import CpuBackend
+from .xla_backend import XlaBackend
+
+
+def get_backend(name: str = "cpu", **kwargs) -> ProofBackend:
+    if name == "cpu":
+        return CpuBackend(**kwargs)
+    if name == "xla":
+        return XlaBackend(**kwargs)
+    raise ValueError(f"unknown proof backend {name!r}")
+
+
+__all__ = [
+    "ProofBackend",
+    "VerifyItem",
+    "CpuBackend",
+    "XlaBackend",
+    "get_backend",
+]

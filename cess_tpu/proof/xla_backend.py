@@ -1,0 +1,106 @@
+"""XLA ProofBackend — the TPU data-plane path.
+
+Work split (SURVEY.md §2 "distributed communication backend" item — keep the
+hot data plane on device, control on host):
+
+ * μ aggregation over challenged sectors (prove) and the ρ-weighted batch
+   combination (verify) run on TPU as base-128 limb matmuls
+   (ops/fr.py) — this is where the bytes are: for the north-star batch the
+   sector data is GiBs while the G1 points are KiBs.
+ * G1 MSMs and the two pairings run host-side via ops/bls12_381.py until
+   the ops/g1.py device kernels land (round-2 frontier).
+
+Verdicts are bit-identical to CpuBackend: the combined equation uses the
+same ρ derivation (ops/podr2.py batch_rho) and the device μ math is
+bit-identical to Python mod-r arithmetic (tests/test_fr.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops import fr, podr2
+from ..ops.bls12_381 import G1Point, R
+from ..ops.podr2 import Challenge, Podr2Params, Podr2Proof
+from .backend import ProofBackend, ProveRequest, VerifyItem
+
+# Fragment-axis chunk for prove_batch: bounds host staging + HBM footprint
+# (47×265×36 limb bytes ≈ 448 KB per fragment).
+_PROVE_CHUNK = 1024
+
+
+class XlaBackend(ProofBackend):
+    name = "xla"
+
+    # ------------------------------------------------------------ verify
+
+    def _combined_check(
+        self,
+        pk: bytes,
+        items: list[VerifyItem],
+        seed: bytes,
+        params: Podr2Params,
+    ) -> bool:
+        """ops/podr2.py batch_verify with the u-side exponents
+        Σ_b ρ_b μ_bj computed on device — the only seam where this backend
+        differs from the host reference."""
+        if not items:
+            return True
+        batch_items = [podr2.BatchItem(n, c, p) for n, c, p in items]
+        if any(len(p.mu) != params.s for _, _, p in items):
+            return False
+        if any(not 0 <= m < R for _, _, p in items for m in p.mu):
+            return False
+        rhos = podr2.batch_rho(
+            podr2.batch_transcript(seed, batch_items), len(items)
+        )
+        mu_limbs = np.stack(
+            [fr.fr_to_limbs(p.mu) for _, _, p in items]
+        )  # (B, S, 37)
+        exps = fr.limbs_to_ints(fr.combine_mu(rhos, mu_limbs))
+        return podr2.batch_verify(pk, batch_items, seed, u_exponents=exps)
+
+    def verify_batch(
+        self,
+        pk: bytes,
+        items: list[VerifyItem],
+        seed: bytes,
+        params: Podr2Params,
+    ) -> list[bool]:
+        def single_check(pk_, item, _params):
+            name, challenge, proof = item
+            return podr2.verify(pk_, name, challenge, proof)
+
+        return self._verdicts_by_bisection(
+            pk, items, seed, params, self._combined_check, single_check
+        )
+
+    # ------------------------------------------------------------ prove
+
+    def prove_batch(self, request: ProveRequest) -> list[Podr2Proof]:
+        """μ on device (challenged sectors only — 47/1024 of the data moves
+        to HBM), σ host-side MSM over the 47 challenged tags."""
+        params = request.params
+        challenge = request.challenge
+        coeffs = challenge.coefficients()
+
+        proofs: list[Podr2Proof] = []
+        for start in range(0, len(request.data), _PROVE_CHUNK):
+            chunk_data = request.data[start : start + _PROVE_CHUNK]
+            chunk_tags = request.tags[start : start + _PROVE_CHUNK]
+            # Challenged rows only — 47/1024 of the fragment bytes move.
+            batches = []
+            for data in chunk_data:
+                matrix = podr2.fragment_sectors(data, params)
+                rows = [matrix[i] for i in challenge.indices]
+                batches.append(fr.sectors_to_limbs(rows))
+            sector_limbs = np.stack(batches)
+            mu_all = fr.mu_aggregate(coeffs, sector_limbs)  # (n, S, 37)
+
+            for b, tags in enumerate(chunk_tags):
+                mu = fr.limbs_to_ints(mu_all[b])
+                sigma = G1Point.infinity()
+                for v, i in zip(coeffs, challenge.indices):
+                    sigma = sigma + G1Point.from_bytes(tags[i]).mul(v)
+                proofs.append(Podr2Proof(sigma.to_bytes(), mu))
+        return proofs

@@ -11,4 +11,13 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the ambient environment pins another platform (the
+# image sets JAX_PLATFORMS=axon for the tunnelled TPU chip — tests must not
+# occupy it and need 8 virtual devices for the mesh suite).  The axon
+# sitecustomize hook rewrites jax_platforms at interpreter start, so the env
+# var alone is not enough: override through jax.config after import.
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

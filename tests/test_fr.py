@@ -1,0 +1,73 @@
+"""Fr limb-kernel tests: bit-identity with Python mod-r arithmetic."""
+
+import random
+
+import numpy as np
+
+from cess_tpu.ops import fr
+
+R = fr.R
+random.seed(99)
+
+
+class TestCodec:
+    def test_limb_roundtrip(self):
+        for x in (0, 1, R - 1, 1 << 254, 12345678901234567890):
+            assert fr.limbs_to_int(fr.int_to_limbs(x, 37)) == x
+
+    def test_rejects_oversized(self):
+        import pytest
+
+        with pytest.raises(ValueError):
+            fr.int_to_limbs(1 << 300, 37)
+
+
+class TestKernel:
+    def test_mu_aggregate_matches_python(self):
+        K, J = 47, 5
+        weights = [random.getrandbits(160) for _ in range(K)]
+        values = [[random.getrandbits(248) for _ in range(J)] for _ in range(K)]
+        out = fr.mu_aggregate(weights, fr.sectors_to_limbs(values)[None])
+        got = fr.limbs_to_ints(out)
+        want = [
+            sum(w * values[k][j] for k, w in enumerate(weights)) % R
+            for j in range(J)
+        ]
+        assert got == want
+
+    def test_combine_mu_matches_python(self):
+        B, S = 16, 7
+        mus = [[random.randrange(R) for _ in range(S)] for _ in range(B)]
+        rhos = [random.getrandbits(128) | 1 for _ in range(B)]
+        out = fr.combine_mu(rhos, np.stack([fr.fr_to_limbs(m) for m in mus]))
+        got = fr.limbs_to_ints(out)
+        want = [
+            sum(r * mus[b][j] for b, r in enumerate(rhos)) % R
+            for j in range(S)
+        ]
+        assert got == want
+
+    def test_edge_values(self):
+        sect = fr.sectors_to_limbs([[0, (1 << 248) - 1]])
+        out = fr.mu_aggregate([(1 << 160) - 1], sect[None])
+        assert fr.limbs_to_ints(out) == [
+            0,
+            ((1 << 160) - 1) * ((1 << 248) - 1) % R,
+        ]
+
+    def test_large_contraction_chunks_correctly(self):
+        """K beyond SAFE_CONTRACTION must not overflow int32 (regression:
+        silently wrong results at K ≈ 8192 before internal chunking)."""
+        B = fr.SAFE_CONTRACTION * 2 + 100
+        S = 2
+        # Worst-case limbs: all-127 values maximize accumulation.
+        max_mu = fr.limbs_to_int([127] * 37)
+        mus = [[max_mu % R, random.randrange(R)] for _ in range(B)]
+        rhos = [(1 << 128) - 1] * B
+        out = fr.combine_mu(rhos, np.stack([fr.fr_to_limbs(m) for m in mus]))
+        got = fr.limbs_to_ints(out)
+        want = [
+            sum(r * mus[b][j] for b, r in enumerate(rhos)) % R
+            for j in range(S)
+        ]
+        assert got == want

@@ -1,0 +1,110 @@
+"""End-to-end node-sim tests: the full protocol with REAL crypto/coding —
+RS-encoded uploads, TEE-tagged fragments, PoDR2 proofs through the
+ProofBackend, BLS-signed TEE verdicts, rewards and punishments."""
+
+import numpy as np
+import pytest
+
+from cess_tpu.chain.node import NodeSim
+from cess_tpu.chain.types import TOKEN
+from cess_tpu.ops.podr2 import Podr2Params
+from cess_tpu.ops.rs import segment_code
+from cess_tpu.utils.hashing import Hash64
+
+PARAMS = Podr2Params(n=8, s=4)  # 124-byte chunks, 992-byte fragments
+
+
+@pytest.fixture(scope="module")
+def sim():
+    sim = NodeSim(n_miners=5, n_validators=3, backend="cpu", params=PARAMS)
+    # On-chain accounting is at protocol scale (8 MiB per filler); the
+    # user's 1 GiB purchase needs ≥128 fillers of network capacity.
+    for m in sim.miners:
+        sim.miner_add_fillers(m, 26)
+    sim.add_user("alice")
+    return sim
+
+
+@pytest.fixture(scope="module")
+def uploaded(sim):
+    content = bytes(
+        (i * 31 + 7) % 256 for i in range(sim.segment_bytes + 100)
+    )  # 2 segments after padding
+    file_hash = sim.user_upload("alice", "holiday-pics", content)
+    return file_hash, content
+
+
+class TestUpload:
+    def test_file_active_and_fragments_stored(self, sim, uploaded):
+        file_hash, _ = uploaded
+        f = sim.rt.file_bank.file[file_hash]
+        assert f.stat == "Active"
+        # 2 segments × 3 fragments, all tagged and stored by real miners.
+        frags = [fr for s in f.segment_list for fr in s.fragment_list]
+        assert len(frags) == 6
+        for frag in frags:
+            stored = sim.store[frag.miner].fragments[frag.hash]
+            assert stored.tags is not None
+            assert Hash64.of(stored.data) == frag.hash
+
+    def test_rs_reconstruction_from_stored_fragments(self, sim, uploaded):
+        """Drop any one fragment of a segment; the other two reconstruct the
+        original segment bytes (the restoral-order capability's math)."""
+        file_hash, content = uploaded
+        f = sim.rt.file_bank.file[file_hash]
+        seg = f.segment_list[0]
+        code = segment_code()
+        shards = [
+            np.frombuffer(
+                sim.store[fr.miner].fragments[fr.hash].data, dtype=np.uint8
+            )
+            for fr in seg.fragment_list
+        ]
+        # Lose shard 0 (a data shard); recover from shard 1 + parity.
+        # reconstruct returns the k data shards in data order.
+        rec = np.asarray(code.reconstruct(np.stack([shards[1], shards[2]]), [1, 2]))
+        assert bytes(rec[1]) == bytes(shards[1])
+        original = (
+            content.ljust(2 * sim.segment_bytes, b"\x00")[: sim.segment_bytes]
+        )
+        rebuilt = bytes(rec[0]) + bytes(rec[1])
+        assert rebuilt == original
+
+
+class TestAuditRound:
+    def test_honest_round_rewards_miners(self, sim, uploaded):
+        sim.rt.staking.end_era()  # fund the reward pool
+        assert sim.rt.sminer.currency_reward > 0
+        results = sim.run_audit_round()
+        assert results, "no miners challenged"
+        for miner, (idle_ok, service_ok) in results.items():
+            assert idle_ok and service_ok
+            assert sim.rt.sminer.reward_map[miner].total_reward > 0
+
+    def test_corrupt_miner_fails_service(self, sim, uploaded):
+        # Corrupt every stored service fragment of one future-challenged
+        # miner, then run rounds until it gets challenged.
+        results = None
+        corrupted = None
+        for _ in range(10):
+            # Pick any miner with service fragments and corrupt its data.
+            if corrupted is None:
+                for m in sim.miners:
+                    if sim.store[m].fragments:
+                        corrupted = m
+                        for frag in sim.store[m].fragments.values():
+                            frag.data = bytes(
+                                b ^ 0xFF for b in frag.data
+                            )
+                        break
+            sim.rt.audit.challenge_snap_shot = None
+            sim.rt.audit.challenge_duration = 0
+            sim.rt.audit.verify_duration = 0
+            sim.rt.next_block()
+            results = sim.run_audit_round()
+            if corrupted in results:
+                break
+        assert corrupted in results, "corrupted miner never challenged"
+        idle_ok, service_ok = results[corrupted]
+        assert idle_ok  # fillers untouched
+        assert not service_ok  # corrupted data cannot prove

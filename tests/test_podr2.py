@@ -1,0 +1,182 @@
+"""PoDR2 scheme tests (host reference): tag → challenge → prove → verify."""
+
+import pytest
+
+from cess_tpu.ops import podr2
+from cess_tpu.ops.bls12_381 import R
+from cess_tpu.ops.podr2 import (
+    BatchItem,
+    Challenge,
+    Podr2Params,
+    Podr2Proof,
+    batch_verify,
+    keygen,
+    prove,
+    tag_fragment,
+    verify,
+)
+
+# Small geometry for tests: 8 chunks × 4 sectors (124-byte chunks).
+PARAMS = Podr2Params(n=8, s=4)
+SK, PK = keygen(b"test-tee")
+
+
+def make_challenge(indices, seed=b"ch"):
+    randoms = tuple(
+        (seed + i.to_bytes(2, "little")).ljust(20, b"\x99") for i in indices
+    )
+    return Challenge(indices=tuple(indices), randoms=randoms)
+
+
+@pytest.fixture(scope="module")
+def tagged():
+    data = bytes(range(256)) * ((PARAMS.fragment_bytes // 256) + 1)
+    data = data[: PARAMS.fragment_bytes]
+    tags = tag_fragment(SK, b"frag-1", data, PARAMS)
+    return data, tags
+
+
+class TestScheme:
+    def test_honest_proof_verifies(self, tagged):
+        data, tags = tagged
+        ch = make_challenge([0, 3, 5])
+        proof = prove(tags, data, ch, PARAMS)
+        assert verify(PK, b"frag-1", ch, proof)
+
+    def test_wrong_data_rejected(self, tagged):
+        data, tags = tagged
+        ch = make_challenge([0, 3, 5])
+        bad = bytearray(data)
+        bad[400] ^= 0xFF  # inside chunk 3 (chunk = 124 bytes)
+        proof = prove(tags, bytes(bad), ch, PARAMS)
+        assert not verify(PK, b"frag-1", ch, proof)
+
+    def test_unchallenged_corruption_not_detected(self, tagged):
+        # Sanity: tampering outside the challenged chunks passes (that's why
+        # the protocol samples randomly each round).
+        data, tags = tagged
+        ch = make_challenge([0, 1])
+        bad = bytearray(data)
+        bad[-1] ^= 0xFF  # last chunk, not challenged
+        proof = prove(tags, bytes(bad), ch, PARAMS)
+        assert verify(PK, b"frag-1", ch, proof)
+
+    def test_wrong_name_rejected(self, tagged):
+        data, tags = tagged
+        ch = make_challenge([2, 4])
+        proof = prove(tags, data, ch, PARAMS)
+        assert not verify(PK, b"other-frag", ch, proof)
+
+    def test_wrong_key_rejected(self, tagged):
+        data, tags = tagged
+        _, pk2 = keygen(b"other-tee")
+        ch = make_challenge([2, 4])
+        proof = prove(tags, data, ch, PARAMS)
+        assert not verify(pk2, b"frag-1", ch, proof)
+
+    def test_forged_sigma_rejected(self, tagged):
+        data, tags = tagged
+        ch = make_challenge([1, 6])
+        proof = prove(tags, data, ch, PARAMS)
+        other = prove(tags, data, make_challenge([0, 2]), PARAMS)
+        forged = Podr2Proof(other.sigma, proof.mu)
+        assert not verify(PK, b"frag-1", ch, forged)
+
+    def test_mu_out_of_range_rejected(self, tagged):
+        data, tags = tagged
+        ch = make_challenge([1, 6])
+        proof = prove(tags, data, ch, PARAMS)
+        proof.mu[0] += R
+        assert not verify(PK, b"frag-1", ch, proof)
+
+    def test_proof_encode_roundtrip(self, tagged):
+        data, tags = tagged
+        ch = make_challenge([0, 7])
+        proof = prove(tags, data, ch, PARAMS)
+        decoded = Podr2Proof.decode(proof.encode(), PARAMS.s)
+        assert decoded.sigma == proof.sigma
+        assert decoded.mu == proof.mu
+        # On-chain commitment fits the reference's SigmaMax bound.
+        assert len(proof.commitment()) == 80 <= 2048
+
+
+class TestBatch:
+    def test_batch_accepts_honest(self, tagged):
+        data, tags = tagged
+        items = []
+        for k in range(4):
+            ch = make_challenge([k, k + 2, 7 - k], seed=bytes([k]))
+            items.append(
+                BatchItem(b"frag-1", ch, prove(tags, data, ch, PARAMS))
+            )
+        assert batch_verify(PK, items, b"round-seed")
+
+    def test_batch_rejects_one_bad(self, tagged):
+        data, tags = tagged
+        items = []
+        for k in range(4):
+            ch = make_challenge([k, k + 2], seed=bytes([k]))
+            items.append(
+                BatchItem(b"frag-1", ch, prove(tags, data, ch, PARAMS))
+            )
+        items[2].proof.mu[1] = (items[2].proof.mu[1] + 1) % R
+        assert not batch_verify(PK, items, b"round-seed")
+
+    def test_batch_multiple_names(self, tagged):
+        data, tags = tagged
+        data2 = bytes(reversed(data))
+        tags2 = tag_fragment(SK, b"frag-2", data2, PARAMS)
+        ch = make_challenge([1, 5])
+        items = [
+            BatchItem(b"frag-1", ch, prove(tags, data, ch, PARAMS)),
+            BatchItem(b"frag-2", ch, prove(tags2, data2, ch, PARAMS)),
+        ]
+        assert batch_verify(PK, items, b"s")
+        # Swapped names must fail.
+        items_swapped = [
+            BatchItem(b"frag-2", ch, items[0].proof),
+            BatchItem(b"frag-1", ch, items[1].proof),
+        ]
+        assert not batch_verify(PK, items_swapped, b"s")
+
+    def test_empty_batch(self):
+        assert batch_verify(PK, [], b"s")
+
+    def test_batch_matches_individual(self, tagged):
+        """Batch verdict agrees with per-proof verdicts (both honest)."""
+        data, tags = tagged
+        ch = make_challenge([0, 4, 6])
+        proof = prove(tags, data, ch, PARAMS)
+        assert verify(PK, b"frag-1", ch, proof)
+        assert batch_verify(PK, [BatchItem(b"frag-1", ch, proof)], b"z")
+
+
+class TestFiller:
+    def test_filler_deterministic(self):
+        a = podr2.filler_data(b"\x01" * 32, PARAMS)
+        b = podr2.filler_data(b"\x01" * 32, PARAMS)
+        c = podr2.filler_data(b"\x02" * 32, PARAMS)
+        assert a == b != c
+        assert len(a) == PARAMS.fragment_bytes
+
+    def test_filler_provable(self):
+        data = podr2.filler_data(b"\x07" * 32, PARAMS)
+        tags = tag_fragment(SK, b"filler-x", data, PARAMS)
+        ch = make_challenge([2, 5])
+        proof = prove(tags, data, ch, PARAMS)
+        assert verify(PK, b"filler-x", ch, proof)
+
+
+class TestFiatShamir:
+    def test_rho_depends_on_proofs(self, tagged):
+        """Batch weights must be unpredictable before proofs are fixed:
+        changing any proof byte must change the transcript (and hence ρ)."""
+        data, tags = tagged
+        ch = make_challenge([0, 3])
+        proof = prove(tags, data, ch, PARAMS)
+        item = BatchItem(b"frag-1", ch, proof)
+        t1 = podr2.batch_transcript(b"seed", [item])
+        tampered = Podr2Proof(proof.sigma, [(proof.mu[0] + 1) % R] + proof.mu[1:])
+        t2 = podr2.batch_transcript(b"seed", [BatchItem(b"frag-1", ch, tampered)])
+        assert t1 != t2
+        assert podr2.batch_rho(t1, 2) != podr2.batch_rho(t2, 2)
