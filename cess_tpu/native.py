@@ -1,0 +1,146 @@
+"""ctypes bindings for the chaincore native library (native/chaincore.cpp).
+
+The native core carries the host-side deterministic primitives (hashing,
+protocol RNG, SCALE compact codec, GF(2^8) Reed-Solomon) in C++ — the role
+the reference delegates to native Rust/C (e.g. the vendored ring crypto,
+reference: utils/ring).  Python remains the source of truth; every binding
+is tested bit-identical against the pure-Python implementation.
+
+`load()` returns None when the library hasn't been built (`make -C native`),
+so the framework degrades gracefully to the Python paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from functools import lru_cache
+
+_SO_PATH = os.path.join(os.path.dirname(__file__), "_native.so")
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), os.pardir, "native")
+
+
+def build(quiet: bool = True) -> bool:
+    """Invoke the Makefile; returns True if the library is present after."""
+    try:
+        subprocess.run(
+            ["make", "-C", _NATIVE_DIR],
+            check=True,
+            capture_output=quiet,
+        )
+    except (OSError, subprocess.CalledProcessError):
+        return False
+    return os.path.exists(_SO_PATH)
+
+
+@lru_cache(maxsize=1)
+def load() -> "ctypes.CDLL | None":
+    if not os.path.exists(_SO_PATH):
+        return None
+    lib = ctypes.CDLL(_SO_PATH)
+    lib.cess_sha256.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+    ]
+    lib.cess_blake2b.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_uint,
+    ]
+    lib.cess_rng_stream.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint64,
+        ctypes.c_char_p, ctypes.c_size_t,
+    ]
+    lib.cess_compact_encode.argtypes = [ctypes.c_uint64, ctypes.c_char_p]
+    lib.cess_compact_encode.restype = ctypes.c_size_t
+    lib.cess_compact_decode.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.cess_compact_decode.restype = ctypes.c_size_t
+    lib.cess_rs_encode.argtypes = [
+        ctypes.c_uint, ctypes.c_uint, ctypes.c_size_t,
+        ctypes.c_char_p, ctypes.c_char_p,
+    ]
+    lib.cess_rs_encode.restype = ctypes.c_int
+    lib.cess_rs_reconstruct.argtypes = [
+        ctypes.c_uint, ctypes.c_uint, ctypes.c_size_t, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint32), ctypes.c_char_p,
+    ]
+    lib.cess_rs_reconstruct.restype = ctypes.c_int
+    lib.cess_abi_version.restype = ctypes.c_uint
+    return lib
+
+
+# ---------------------------------------------------------------- wrappers
+
+
+def sha256(data: bytes) -> bytes:
+    lib = load()
+    assert lib is not None, "native library not built (make -C native)"
+    out = ctypes.create_string_buffer(32)
+    lib.cess_sha256(data, len(data), out)
+    return out.raw
+
+
+def blake2b(data: bytes, digest_size: int = 32) -> bytes:
+    lib = load()
+    assert lib is not None, "native library not built (make -C native)"
+    out = ctypes.create_string_buffer(digest_size)
+    lib.cess_blake2b(data, len(data), out, digest_size)
+    return out.raw
+
+
+def rng_stream(seed: bytes, domain: int, n: int) -> bytes:
+    lib = load()
+    assert lib is not None, "native library not built (make -C native)"
+    out = ctypes.create_string_buffer(n)
+    lib.cess_rng_stream(seed, len(seed), domain, out, n)
+    return out.raw
+
+
+def compact_encode(value: int) -> bytes:
+    lib = load()
+    assert lib is not None, "native library not built (make -C native)"
+    out = ctypes.create_string_buffer(9)
+    n = lib.cess_compact_encode(value, out)
+    return out.raw[:n]
+
+
+def compact_decode(data: bytes) -> tuple[int, int]:
+    """Returns (value, consumed); raises ValueError on malformed input."""
+    lib = load()
+    assert lib is not None, "native library not built (make -C native)"
+    out = ctypes.c_uint64()
+    n = lib.cess_compact_decode(data, len(data), ctypes.byref(out))
+    if n == 0:
+        raise ValueError("malformed or non-canonical compact encoding")
+    return out.value, n
+
+
+def rs_encode(k: int, m: int, data_shards: list[bytes]) -> list[bytes]:
+    lib = load()
+    assert lib is not None, "native library not built (make -C native)"
+    shard_len = len(data_shards[0])
+    assert len(data_shards) == k
+    assert all(len(s) == shard_len for s in data_shards)
+    parity = ctypes.create_string_buffer(m * shard_len)
+    rc = lib.cess_rs_encode(k, m, shard_len, b"".join(data_shards), parity)
+    if rc != 0:
+        raise ValueError("rs_encode failed")
+    return [
+        parity.raw[i * shard_len : (i + 1) * shard_len] for i in range(m)
+    ]
+
+
+def rs_reconstruct(
+    k: int, m: int, shards: list[bytes], present: list[int]
+) -> list[bytes]:
+    lib = load()
+    assert lib is not None, "native library not built (make -C native)"
+    shard_len = len(shards[0])
+    arr = (ctypes.c_uint32 * k)(*present[:k])
+    out = ctypes.create_string_buffer(k * shard_len)
+    rc = lib.cess_rs_reconstruct(
+        k, m, shard_len, b"".join(shards[:k]), arr, out
+    )
+    if rc != 0:
+        raise ValueError("rs_reconstruct failed")
+    return [out.raw[i * shard_len : (i + 1) * shard_len] for i in range(k)]

@@ -1,0 +1,451 @@
+// chaincore — native host core primitives for cess_tpu.
+//
+// The reference implements its host runtime in native code (Rust pallets +
+// vendored C/asm crypto in utils/ring); this library is the framework's
+// native equivalent for the deterministic host primitives:
+//
+//   * SHA-256 and BLAKE2b-256 (constants derived at runtime from prime
+//     square/cube roots — no magic tables to mistype),
+//   * the protocol RNG stream (identical to cess_tpu/utils/rng.py),
+//   * SCALE-compatible compact integer encode/decode
+//     (cess_tpu/utils/codec.py),
+//   * GF(2^8) Reed-Solomon encode/reconstruct with the same Cauchy
+//     generator as cess_tpu/ops/gf256.py (primitive polynomial 0x11D).
+//
+// Exported as a plain C ABI consumed via ctypes (cess_tpu/native.py); every
+// function is covered by bit-identity tests against the Python reference.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+#if defined(_WIN32)
+#define CESS_EXPORT extern "C" __declspec(dllexport)
+#else
+#define CESS_EXPORT extern "C" __attribute__((visibility("default")))
+#endif
+
+namespace {
+
+// ------------------------------------------------------------------ util
+
+static inline uint32_t rotr32(uint32_t x, unsigned n) {
+  return (x >> n) | (x << (32 - n));
+}
+static inline uint64_t rotr64(uint64_t x, unsigned n) {
+  return (x >> n) | (x << (64 - n));
+}
+
+// First 64 primes, for deriving SHA-256 / BLAKE2b constants.
+static const unsigned kPrimes[64] = {
+    2,   3,   5,   7,   11,  13,  17,  19,  23,  29,  31,  37,  41,
+    43,  47,  53,  59,  61,  67,  71,  73,  79,  83,  89,  97,  101,
+    103, 107, 109, 113, 127, 131, 137, 139, 149, 151, 157, 163, 167,
+    173, 179, 181, 191, 193, 197, 199, 211, 223, 227, 229, 233, 239,
+    241, 251, 257, 263, 269, 271, 277, 281, 283, 293, 307, 311};
+
+// frac(p^(1/2)) * 2^bits, exact integer arithmetic.
+//
+// Searches the fractional part f directly: with ip = floor(sqrt(p)) and
+// d = p - ip^2, (ip·2^b + f)^2 <= p·2^2b  ⇔  (f^2 >> b) + 2·ip·f <= d·2^b
+// (with the dropped low bits of f^2 breaking ties) — every term fits in
+// 128 bits even at b = 64, where squaring the full value would overflow.
+static uint64_t frac_sqrt(unsigned p, unsigned bits) {
+  uint64_t ip = 1;
+  while ((ip + 1) * (ip + 1) <= p) ip++;
+  unsigned __int128 d = p - ip * ip;
+  unsigned __int128 rhs = d << bits;
+  unsigned __int128 mask =
+      (bits == 64) ? ~(uint64_t)0 : ((((unsigned __int128)1) << bits) - 1);
+  uint64_t lo = 0, hi = ~(uint64_t)0;  // f in [0, 2^bits)
+  if (bits < 64) hi = (1ULL << bits) - 1;
+  while (lo < hi) {
+    uint64_t f = lo + (hi - lo) / 2 + 1;  // upper mid, overflow-safe
+    unsigned __int128 f2 = (unsigned __int128)f * f;
+    unsigned __int128 lhs = (f2 >> bits) + (unsigned __int128)2 * ip * f;
+    bool ok = lhs < rhs || (lhs == rhs && (f2 & mask) == 0);
+    if (ok)
+      lo = f;
+    else
+      hi = f - 1;
+  }
+  return lo;
+}
+
+// frac(p^(1/3)) * 2^32.
+static uint32_t frac_cbrt(unsigned p) {
+  // cbrt of p << 96 via binary search.
+  unsigned __int128 target_hi = (unsigned __int128)p << 96;
+  unsigned __int128 lo = 0, hi = ((unsigned __int128)1) << 40;
+  while (lo + 1 < hi) {
+    unsigned __int128 mid = (lo + hi) >> 1;
+    if (mid * mid * mid <= target_hi)
+      lo = mid;
+    else
+      hi = mid;
+  }
+  unsigned ip = 1;
+  while ((uint64_t)(ip + 1) * (ip + 1) * (ip + 1) <= p) ip++;
+  return (uint32_t)(lo - ((unsigned __int128)ip << 32));
+}
+
+// ------------------------------------------------------------------ SHA-256
+
+struct Sha256Tables {
+  uint32_t K[64];
+  uint32_t H0[8];
+  Sha256Tables() {
+    for (int i = 0; i < 64; i++) K[i] = frac_cbrt(kPrimes[i]);
+    for (int i = 0; i < 8; i++) H0[i] = (uint32_t)frac_sqrt(kPrimes[i], 32);
+  }
+};
+static const Sha256Tables kSha;
+
+static void sha256_compress(uint32_t h[8], const uint8_t block[64]) {
+  uint32_t w[64];
+  for (int i = 0; i < 16; i++)
+    w[i] = (uint32_t(block[4 * i]) << 24) | (uint32_t(block[4 * i + 1]) << 16) |
+           (uint32_t(block[4 * i + 2]) << 8) | uint32_t(block[4 * i + 3]);
+  for (int i = 16; i < 64; i++) {
+    uint32_t s0 = rotr32(w[i - 15], 7) ^ rotr32(w[i - 15], 18) ^ (w[i - 15] >> 3);
+    uint32_t s1 = rotr32(w[i - 2], 17) ^ rotr32(w[i - 2], 19) ^ (w[i - 2] >> 10);
+    w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+  }
+  uint32_t a = h[0], b = h[1], c = h[2], d = h[3];
+  uint32_t e = h[4], f = h[5], g = h[6], hh = h[7];
+  for (int i = 0; i < 64; i++) {
+    uint32_t S1 = rotr32(e, 6) ^ rotr32(e, 11) ^ rotr32(e, 25);
+    uint32_t ch = (e & f) ^ (~e & g);
+    uint32_t t1 = hh + S1 + ch + kSha.K[i] + w[i];
+    uint32_t S0 = rotr32(a, 2) ^ rotr32(a, 13) ^ rotr32(a, 22);
+    uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+    uint32_t t2 = S0 + maj;
+    hh = g; g = f; f = e; e = d + t1;
+    d = c; c = b; b = a; a = t1 + t2;
+  }
+  h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+  h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+}
+
+static void sha256(const uint8_t* data, size_t len, uint8_t out[32]) {
+  uint32_t h[8];
+  memcpy(h, kSha.H0, sizeof(h));
+  size_t full = len / 64;
+  for (size_t i = 0; i < full; i++) sha256_compress(h, data + 64 * i);
+  uint8_t tail[128] = {0};
+  size_t rem = len - full * 64;
+  memcpy(tail, data + full * 64, rem);
+  tail[rem] = 0x80;
+  size_t tail_len = (rem < 56) ? 64 : 128;
+  uint64_t bitlen = (uint64_t)len * 8;
+  for (int i = 0; i < 8; i++)
+    tail[tail_len - 1 - i] = (uint8_t)(bitlen >> (8 * i));
+  for (size_t i = 0; i < tail_len; i += 64) sha256_compress(h, tail + i);
+  for (int i = 0; i < 8; i++) {
+    out[4 * i] = (uint8_t)(h[i] >> 24);
+    out[4 * i + 1] = (uint8_t)(h[i] >> 16);
+    out[4 * i + 2] = (uint8_t)(h[i] >> 8);
+    out[4 * i + 3] = (uint8_t)h[i];
+  }
+}
+
+// ------------------------------------------------------------------ BLAKE2b
+
+struct Blake2bTables {
+  uint64_t IV[8];
+  Blake2bTables() {
+    for (int i = 0; i < 8; i++) IV[i] = frac_sqrt(kPrimes[i], 64);
+  }
+};
+static const Blake2bTables kB2;
+
+static const uint8_t kSigma[12][16] = {
+    {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
+    {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3},
+    {11, 8, 12, 0, 5, 2, 15, 13, 10, 14, 3, 6, 7, 1, 9, 4},
+    {7, 9, 3, 1, 13, 12, 11, 14, 2, 6, 5, 10, 4, 0, 15, 8},
+    {9, 0, 5, 7, 2, 4, 10, 15, 14, 1, 11, 12, 6, 8, 3, 13},
+    {2, 12, 6, 10, 0, 11, 8, 3, 4, 13, 7, 5, 15, 14, 1, 9},
+    {12, 5, 1, 15, 14, 13, 4, 10, 0, 7, 6, 3, 9, 2, 8, 11},
+    {13, 11, 7, 14, 12, 1, 3, 9, 5, 0, 15, 4, 8, 6, 2, 10},
+    {6, 15, 14, 9, 11, 3, 0, 8, 12, 2, 13, 7, 1, 4, 10, 5},
+    {10, 2, 8, 4, 7, 6, 1, 5, 15, 11, 9, 14, 3, 12, 13, 0},
+    {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
+    {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3}};
+
+static inline void b2_g(uint64_t v[16], int a, int b, int c, int d,
+                        uint64_t x, uint64_t y) {
+  v[a] = v[a] + v[b] + x;
+  v[d] = rotr64(v[d] ^ v[a], 32);
+  v[c] = v[c] + v[d];
+  v[b] = rotr64(v[b] ^ v[c], 24);
+  v[a] = v[a] + v[b] + y;
+  v[d] = rotr64(v[d] ^ v[a], 16);
+  v[c] = v[c] + v[d];
+  v[b] = rotr64(v[b] ^ v[c], 63);
+}
+
+static void b2_compress(uint64_t h[8], const uint8_t block[128],
+                        uint64_t t, bool last) {
+  uint64_t m[16], v[16];
+  for (int i = 0; i < 16; i++) {
+    m[i] = 0;
+    for (int j = 7; j >= 0; j--) m[i] = (m[i] << 8) | block[8 * i + j];
+  }
+  for (int i = 0; i < 8; i++) v[i] = h[i];
+  for (int i = 0; i < 8; i++) v[8 + i] = kB2.IV[i];
+  v[12] ^= t;         // t low (messages < 2^64 bytes)
+  if (last) v[14] = ~v[14];
+  for (int r = 0; r < 12; r++) {
+    const uint8_t* s = kSigma[r];
+    b2_g(v, 0, 4, 8, 12, m[s[0]], m[s[1]]);
+    b2_g(v, 1, 5, 9, 13, m[s[2]], m[s[3]]);
+    b2_g(v, 2, 6, 10, 14, m[s[4]], m[s[5]]);
+    b2_g(v, 3, 7, 11, 15, m[s[6]], m[s[7]]);
+    b2_g(v, 0, 5, 10, 15, m[s[8]], m[s[9]]);
+    b2_g(v, 1, 6, 11, 12, m[s[10]], m[s[11]]);
+    b2_g(v, 2, 7, 8, 13, m[s[12]], m[s[13]]);
+    b2_g(v, 3, 4, 9, 14, m[s[14]], m[s[15]]);
+  }
+  for (int i = 0; i < 8; i++) h[i] ^= v[i] ^ v[8 + i];
+}
+
+// Unkeyed BLAKE2b with `outlen` digest bytes (1..64).
+static void blake2b(const uint8_t* data, size_t len, uint8_t* out,
+                    unsigned outlen) {
+  uint64_t h[8];
+  for (int i = 0; i < 8; i++) h[i] = kB2.IV[i];
+  h[0] ^= 0x01010000ULL ^ (uint64_t)outlen;  // depth=1, fanout=1, nn=outlen
+  uint8_t block[128];
+  size_t off = 0;
+  uint64_t t = 0;
+  while (len - off > 128) {
+    t += 128;
+    b2_compress(h, data + off, t, false);
+    off += 128;
+  }
+  size_t rem = len - off;
+  memset(block, 0, sizeof(block));
+  memcpy(block, data + off, rem);
+  t += rem;
+  b2_compress(h, block, t, true);
+  for (unsigned i = 0; i < outlen; i++)
+    out[i] = (uint8_t)(h[i / 8] >> (8 * (i % 8)));
+}
+
+// ------------------------------------------------------------------ GF(2^8)
+
+struct GfTables {
+  uint8_t mul[256][256];
+  uint8_t inv[256];
+  GfTables() {
+    uint8_t exp[512];
+    int log[256] = {0};
+    int x = 1;
+    for (int i = 0; i < 255; i++) {
+      exp[i] = (uint8_t)x;
+      log[x] = i;
+      x <<= 1;
+      if (x & 0x100) x ^= 0x11D;
+    }
+    for (int i = 255; i < 510; i++) exp[i] = exp[i - 255];
+    memset(mul, 0, sizeof(mul));
+    for (int a = 1; a < 256; a++)
+      for (int b = 1; b < 256; b++)
+        mul[a][b] = exp[(log[a] + log[b]) % 255];
+    inv[0] = 0;
+    for (int a = 1; a < 256; a++) inv[a] = exp[255 - log[a]];
+  }
+};
+static const GfTables kGf;
+
+// Cauchy parity matrix row-major (m x k): M[j][i] = inv[(k+j) ^ i].
+static void cauchy_matrix(unsigned k, unsigned m, uint8_t* out) {
+  for (unsigned j = 0; j < m; j++)
+    for (unsigned i = 0; i < k; i++) out[j * k + i] = kGf.inv[(k + j) ^ i];
+}
+
+// Invert an n x n GF(256) matrix in place via Gauss-Jordan. Returns 0 on
+// success, -1 if singular.
+static int gf_mat_inv(unsigned n, uint8_t* mat, uint8_t* out) {
+  std::vector<uint8_t> aug(n * 2 * n, 0);
+  for (unsigned r = 0; r < n; r++) {
+    memcpy(&aug[r * 2 * n], mat + r * n, n);
+    aug[r * 2 * n + n + r] = 1;
+  }
+  for (unsigned col = 0; col < n; col++) {
+    unsigned pivot = col;
+    while (pivot < n && aug[pivot * 2 * n + col] == 0) pivot++;
+    if (pivot == n) return -1;
+    if (pivot != col)
+      for (unsigned j = 0; j < 2 * n; j++)
+        std::swap(aug[col * 2 * n + j], aug[pivot * 2 * n + j]);
+    uint8_t ip = kGf.inv[aug[col * 2 * n + col]];
+    for (unsigned j = 0; j < 2 * n; j++)
+      aug[col * 2 * n + j] = kGf.mul[ip][aug[col * 2 * n + j]];
+    for (unsigned r = 0; r < n; r++) {
+      if (r == col) continue;
+      uint8_t f = aug[r * 2 * n + col];
+      if (!f) continue;
+      for (unsigned j = 0; j < 2 * n; j++)
+        aug[r * 2 * n + j] ^= kGf.mul[f][aug[col * 2 * n + j]];
+    }
+  }
+  for (unsigned r = 0; r < n; r++) memcpy(out + r * n, &aug[r * 2 * n + n], n);
+  return 0;
+}
+
+// out[rows x len] = mat[rows x k] * data[k x len] over GF(256).
+static void gf_mat_apply(unsigned rows, unsigned k, size_t len,
+                         const uint8_t* mat, const uint8_t* data,
+                         uint8_t* out) {
+  memset(out, 0, (size_t)rows * len);
+  for (unsigned r = 0; r < rows; r++) {
+    for (unsigned i = 0; i < k; i++) {
+      const uint8_t* mrow = kGf.mul[mat[r * k + i]];
+      const uint8_t* src = data + (size_t)i * len;
+      uint8_t* dst = out + (size_t)r * len;
+      for (size_t b = 0; b < len; b++) dst[b] ^= mrow[src[b]];
+    }
+  }
+}
+
+}  // namespace
+
+// ------------------------------------------------------------------ C ABI
+
+CESS_EXPORT void cess_sha256(const uint8_t* data, size_t len,
+                             uint8_t out[32]) {
+  sha256(data, len, out);
+}
+
+CESS_EXPORT void cess_blake2b(const uint8_t* data, size_t len, uint8_t* out,
+                              unsigned outlen) {
+  blake2b(data, len, out, outlen);
+}
+
+// Protocol RNG stream (cess_tpu/utils/rng.py frozen definition):
+//   state = blake2b256(seed || u64le(domain))
+//   block_i = blake2b256(state || u64le(i)),  stream = block_0 || block_1 …
+CESS_EXPORT void cess_rng_stream(const uint8_t* seed, size_t seed_len,
+                                 uint64_t domain, uint8_t* out, size_t n) {
+  std::vector<uint8_t> buf(seed_len + 8);
+  memcpy(buf.data(), seed, seed_len);
+  for (int i = 0; i < 8; i++) buf[seed_len + i] = (uint8_t)(domain >> (8 * i));
+  uint8_t state[32];
+  blake2b(buf.data(), buf.size(), state, 32);
+  uint8_t block_in[40];
+  memcpy(block_in, state, 32);
+  uint64_t counter = 0;
+  size_t off = 0;
+  while (off < n) {
+    for (int i = 0; i < 8; i++) block_in[32 + i] = (uint8_t)(counter >> (8 * i));
+    uint8_t block[32];
+    blake2b(block_in, sizeof(block_in), block, 32);
+    size_t take = (n - off < 32) ? n - off : 32;
+    memcpy(out + off, block, take);
+    off += take;
+    counter++;
+  }
+}
+
+// SCALE compact encoding; returns byte count written (≤ 9 for u64).
+CESS_EXPORT size_t cess_compact_encode(uint64_t v, uint8_t out[9]) {
+  if (v < (1ULL << 6)) {
+    out[0] = (uint8_t)(v << 2);
+    return 1;
+  }
+  if (v < (1ULL << 14)) {
+    uint16_t enc = (uint16_t)((v << 2) | 0b01);
+    out[0] = (uint8_t)enc;
+    out[1] = (uint8_t)(enc >> 8);
+    return 2;
+  }
+  if (v < (1ULL << 30)) {
+    uint32_t enc = (uint32_t)((v << 2) | 0b10);
+    for (int i = 0; i < 4; i++) out[i] = (uint8_t)(enc >> (8 * i));
+    return 4;
+  }
+  unsigned nbytes = 0;
+  uint64_t tmp = v;
+  while (tmp) {
+    nbytes++;
+    tmp >>= 8;
+  }
+  out[0] = (uint8_t)(((nbytes - 4) << 2) | 0b11);
+  for (unsigned i = 0; i < nbytes; i++) out[1 + i] = (uint8_t)(v >> (8 * i));
+  return 1 + nbytes;
+}
+
+// Decode; returns consumed bytes, or 0 on malformed/non-canonical input.
+CESS_EXPORT size_t cess_compact_decode(const uint8_t* data, size_t len,
+                                       uint64_t* out) {
+  if (len == 0) return 0;
+  unsigned mode = data[0] & 0b11;
+  if (mode == 0b00) {
+    *out = data[0] >> 2;
+    return 1;
+  }
+  if (mode == 0b01) {
+    if (len < 2) return 0;
+    uint64_t v = ((uint64_t)data[0] | ((uint64_t)data[1] << 8)) >> 2;
+    if (v < (1ULL << 6)) return 0;
+    *out = v;
+    return 2;
+  }
+  if (mode == 0b10) {
+    if (len < 4) return 0;
+    uint64_t v = 0;
+    for (int i = 3; i >= 0; i--) v = (v << 8) | data[i];
+    v >>= 2;
+    if (v < (1ULL << 14)) return 0;
+    *out = v;
+    return 4;
+  }
+  unsigned nbytes = (data[0] >> 2) + 4;
+  if (nbytes > 8 || len < 1 + nbytes) return 0;
+  uint64_t v = 0;
+  for (int i = (int)nbytes - 1; i >= 0; i--) v = (v << 8) | data[1 + i];
+  if (v < (1ULL << 30) || (nbytes > 1 && v < (1ULL << (8 * (nbytes - 1)))))
+    return 0;
+  *out = v;
+  return 1 + nbytes;
+}
+
+// RS(k, m) encode: data = k contiguous shards of shard_len bytes; writes m
+// parity shards into `parity`. Returns 0, or -1 on bad geometry.
+CESS_EXPORT int cess_rs_encode(unsigned k, unsigned m, size_t shard_len,
+                               const uint8_t* data, uint8_t* parity) {
+  if (k == 0 || m == 0 || k + m > 256) return -1;
+  std::vector<uint8_t> mat((size_t)m * k);
+  cauchy_matrix(k, m, mat.data());
+  gf_mat_apply(m, k, shard_len, mat.data(), data, parity);
+  return 0;
+}
+
+// RS(k, m) reconstruct: `shards` holds k surviving shards (contiguous) whose
+// global indices (0..k+m-1, data first) are in `present`; writes the k data
+// shards into `out`. Returns 0, or -1 on bad input.
+CESS_EXPORT int cess_rs_reconstruct(unsigned k, unsigned m, size_t shard_len,
+                                    const uint8_t* shards,
+                                    const uint32_t* present, uint8_t* out) {
+  if (k == 0 || m == 0 || k + m > 256) return -1;
+  // Build the generator rows for the surviving shards.
+  std::vector<uint8_t> sub((size_t)k * k);
+  for (unsigned r = 0; r < k; r++) {
+    unsigned idx = present[r];
+    if (idx >= k + m) return -1;
+    if (idx < k) {
+      memset(&sub[r * k], 0, k);
+      sub[r * k + idx] = 1;
+    } else {
+      for (unsigned i = 0; i < k; i++) sub[r * k + i] = kGf.inv[idx ^ i];
+    }
+  }
+  std::vector<uint8_t> inv((size_t)k * k);
+  if (gf_mat_inv(k, sub.data(), inv.data()) != 0) return -1;
+  gf_mat_apply(k, k, shard_len, inv.data(), shards, out);
+  return 0;
+}
+
+CESS_EXPORT unsigned cess_abi_version(void) { return 1; }

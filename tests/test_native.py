@@ -1,0 +1,107 @@
+"""Native chaincore bit-identity tests (builds the library if needed)."""
+
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+from cess_tpu import native
+from cess_tpu.ops import gf256
+from cess_tpu.utils import codec
+from cess_tpu.utils.rng import ProtocolRng
+
+
+@pytest.fixture(scope="module")
+def lib():
+    if native.load() is None:
+        assert native.build(), "native build failed (g++ required)"
+        native.load.cache_clear()
+    lib = native.load()
+    assert lib is not None
+    return lib
+
+
+class TestHashes:
+    def test_sha256_matches_hashlib(self, lib):
+        for data in (b"", b"abc", b"x" * 1000, os.urandom(12345)):
+            assert native.sha256(data) == hashlib.sha256(data).digest()
+
+    def test_blake2b_matches_hashlib(self, lib):
+        for data in (b"", b"abc", b"y" * 129, os.urandom(4096)):
+            assert (
+                native.blake2b(data)
+                == hashlib.blake2b(data, digest_size=32).digest()
+            )
+            assert native.blake2b(data, 64) == hashlib.blake2b(data).digest()
+
+    def test_block_boundaries(self, lib):
+        # SHA-256: 55/56/64-byte padding boundaries; BLAKE2b: 128/129.
+        for n in (55, 56, 63, 64, 65, 127, 128, 129, 256):
+            data = bytes(range(256))[:n] * 1
+            assert native.sha256(data) == hashlib.sha256(data).digest()
+            assert (
+                native.blake2b(data)
+                == hashlib.blake2b(data, digest_size=32).digest()
+            )
+
+
+class TestRng:
+    def test_stream_matches_python(self, lib):
+        for seed, dom, n in (
+            (b"seed", 0, 100),
+            (b"", 7, 33),
+            (os.urandom(32), 2**63, 200),
+            (b"q", 2**64 - 1, 1),
+        ):
+            assert native.rng_stream(seed, dom, n) == ProtocolRng(
+                seed, dom
+            ).take(n)
+
+
+class TestCompact:
+    def test_roundtrip_matches_python(self, lib):
+        for v in (0, 1, 63, 64, 2**14 - 1, 2**14, 2**30 - 1, 2**30,
+                  2**40, 2**64 - 1):
+            enc = native.compact_encode(v)
+            assert enc == codec.encode_compact(v)
+            assert native.compact_decode(enc) == (v, len(enc))
+
+    def test_rejects_noncanonical(self, lib):
+        # 64 encoded in 4-byte mode is non-canonical.
+        bad = ((64 << 2) | 0b10).to_bytes(4, "little")
+        with pytest.raises(ValueError):
+            native.compact_decode(bad)
+
+
+class TestRs:
+    @pytest.mark.parametrize("k,m", [(2, 1), (12, 4), (5, 3)])
+    def test_encode_matches_reference(self, lib, k, m):
+        rng = np.random.default_rng(42)
+        data = rng.integers(0, 256, size=(k, 2048), dtype=np.uint8)
+        parity = native.rs_encode(k, m, [bytes(r) for r in data])
+        ref = gf256.rs_encode_ref(data, k, m)
+        assert parity == [bytes(r) for r in ref]
+
+    @pytest.mark.parametrize("k,m", [(2, 1), (12, 4)])
+    def test_reconstruct_any_k(self, lib, k, m):
+        rng = np.random.default_rng(43)
+        data = rng.integers(0, 256, size=(k, 512), dtype=np.uint8)
+        parity = native.rs_encode(k, m, [bytes(r) for r in data])
+        shards = [bytes(r) for r in data] + parity
+        # Worst case: all parity + tail of data.
+        present = list(range(m, k + m))[-k:]
+        rec = native.rs_reconstruct(
+            k, m, [shards[i] for i in present], present
+        )
+        assert rec == [bytes(r) for r in data]
+
+    def test_matches_jax_kernel(self, lib):
+        """Native RS and the TPU bitplane kernel agree."""
+        from cess_tpu.ops.rs import RSCode
+
+        rng = np.random.default_rng(44)
+        data = rng.integers(0, 256, size=(12, 1024), dtype=np.uint8)
+        native_parity = native.rs_encode(12, 4, [bytes(r) for r in data])
+        jax_parity = np.asarray(RSCode(12, 4).encode(data))
+        assert [bytes(r) for r in jax_parity] == native_parity
