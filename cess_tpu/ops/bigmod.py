@@ -1,0 +1,245 @@
+"""Generic fixed-modulus big-integer arithmetic on TPU (base-128 limbs).
+
+Generalizes the Fr machinery (ops/fr.py) to an arbitrary odd modulus fixed
+per batch — the RSA case: every IAS report in a batch is verified against
+the same Intel signing key, so the modulus-dependent fold tables are
+precomputed once on host and the per-report modexp runs as batched limb
+matmuls on device (reference capability: primitives/enclave-verify/src/
+lib.rs:221-228 verify_rsa over the rsa crate).
+
+A `ModContext` freezes: modulus limbs, the 2^(7k) mod n fold table, and the
+conditional-subtract count.  `modmul_batch` / `modexp_65537_batch` are the
+device entry points; both are bit-identical to Python `pow`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+LIMB_BITS = 7
+BASE = 1 << LIMB_BITS
+
+
+def int_to_limbs(x: int, n: int) -> np.ndarray:
+    if x < 0 or x >> (LIMB_BITS * n):
+        raise ValueError(f"{x} does not fit in {n} limbs")
+    out = np.zeros(n, dtype=np.int8)
+    for i in range(n):
+        out[i] = x & (BASE - 1)
+        x >>= LIMB_BITS
+    return out
+
+
+def limbs_to_int(limbs) -> int:
+    x = 0
+    for i, limb in enumerate(np.asarray(limbs).astype(np.int64).tolist()):
+        x += int(limb) << (LIMB_BITS * i)
+    return x
+
+
+@dataclass(frozen=True)
+class ModContext:
+    """Precomputed device tables for arithmetic mod a fixed modulus."""
+
+    modulus: int
+    nlimbs: int
+    mod_limbs: np.ndarray = field(repr=False)
+    # fold table: 2^(7k) mod n for k in [nlimbs, 2*nlimbs+6)
+    fold_table: np.ndarray = field(repr=False)
+    # n·2^k for k = 9..0: shifted-multiple subtraction reaches canonical in
+    # 10+1 passes for ANY modulus (value after folds < 2^8·n; 2^9 margin).
+    mod_shifts: np.ndarray = field(repr=False)
+
+    @classmethod
+    def create(cls, modulus: int) -> "ModContext":
+        nl = (modulus.bit_length() + LIMB_BITS - 1) // LIMB_BITS
+        mod_limbs = int_to_limbs(modulus, nl).astype(np.int32)
+        hi = nl + 6
+        fold = np.stack(
+            [
+                int_to_limbs(pow(2, LIMB_BITS * k, modulus), nl)
+                for k in range(nl, 2 * nl + hi)
+            ]
+        ).astype(np.int32)
+        # Post-fold residual is provably < 2^8·n (see _to_canonical);
+        # starting at n·2^9 gives 2x margin.
+        shifts = np.stack(
+            [
+                int_to_limbs(modulus << k, nl + 2).astype(np.int32)
+                for k in range(9, -1, -1)
+            ]
+        )
+        return cls(
+            modulus=modulus,
+            nlimbs=nl,
+            mod_limbs=mod_limbs,
+            fold_table=fold,
+            mod_shifts=shifts,
+        )
+
+    def to_device_limbs(self, values: list[int]) -> np.ndarray:
+        return np.stack([int_to_limbs(v, self.nlimbs) for v in values])
+
+    def from_device_limbs(self, arr) -> list[int]:
+        a = np.asarray(arr)
+        return [limbs_to_int(row) for row in a.reshape(-1, a.shape[-1])]
+
+
+# ---------------------------------------------------------------- kernels
+
+
+def _carry_pass(x: jnp.ndarray) -> jnp.ndarray:
+    low = x & (BASE - 1)
+    carry = x >> LIMB_BITS
+    return low + jnp.pad(carry[..., :-1], [(0, 0)] * (x.ndim - 1) + [(1, 0)])
+
+
+def _normalize(x: jnp.ndarray, passes: int = 6) -> jnp.ndarray:
+    for _ in range(passes):
+        x = _carry_pass(x)
+    return x
+
+
+def _cond_sub(x: jnp.ndarray, mod_limbs: jnp.ndarray) -> jnp.ndarray:
+    """where(x >= r, x - r, x) — borrow propagation as a lax.scan over the
+    limb axis (an unrolled chain makes compile time explode at RSA sizes)."""
+    length = x.shape[-1]
+    r = jnp.pad(mod_limbs, (0, length - mod_limbs.shape[0]))
+    diff = x - r
+
+    def step(borrow, d):
+        d2 = d - borrow
+        b = (d2 < 0).astype(jnp.int32)
+        return b, d2 + b * BASE
+
+    borrow0 = jnp.zeros(x.shape[:-1], dtype=jnp.int32)
+    borrow, sub = jax.lax.scan(step, borrow0, jnp.moveaxis(diff, -1, 0))
+    sub = jnp.moveaxis(sub, 0, -1)
+    return jnp.where((borrow == 0)[..., None], sub, x)
+
+
+def _fold(x: jnp.ndarray, ctx_tables) -> jnp.ndarray:
+    """One fold of limbs ≥ nlimbs through the 2^(7k) mod n table; returns
+    (…, nlimbs+2) normalized limbs congruent mod n."""
+    fold_table, nlimbs = ctx_tables
+    pad_spec = [(0, 0)] * (x.ndim - 1)
+    low, high = x[..., :nlimbs], x[..., nlimbs:]
+    if high.shape[-1] == 0:
+        return _normalize(jnp.pad(x, pad_spec + [(0, 2)]))
+    table = fold_table[: high.shape[-1]]
+    folded = jax.lax.dot_general(
+        high.astype(jnp.int32),
+        table,
+        (((high.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return _normalize(jnp.pad(low + folded, pad_spec + [(0, 2)]))
+
+
+def _fold_partial(x: jnp.ndarray, fold_table, nlimbs) -> jnp.ndarray:
+    """Normalized limbs of any length → (…, nlimbs+2) limbs representing a
+    value < 2^9·n congruent mod n — the *partial* form chained through a
+    modexp.  Canonicalization (the expensive unrolled borrow chains) runs
+    once at the end, not per multiplication."""
+    tables = (fold_table, nlimbs)
+    x = _fold(x, tables)
+    for _ in range(3):
+        x = _fold(x[..., : nlimbs + 2], tables)
+    return x[..., : nlimbs + 2]
+
+
+def _canonicalize(x: jnp.ndarray, mod_shifts, nlimbs) -> jnp.ndarray:
+    """Partial form (< 2^9·n, normalized) → canonical < n via conditional
+    subtraction of n·2^9 … n·2^0 plus one residual pass."""
+    for k in range(mod_shifts.shape[0]):
+        x = _cond_sub(x, mod_shifts[k])
+    x = _cond_sub(x, mod_shifts[-1])
+    return x[..., :nlimbs]
+
+
+def _antidiagonal_sums(t: jnp.ndarray) -> jnp.ndarray:
+    """(…, L, L) → (…, 2L-1): out[k] = Σ_{i+j=k} t[i, j].
+
+    Shear trick: pad rows to width 2L, flatten, re-split at width 2L-1 —
+    row i's element j lands in column i+j — then sum rows.  O(L²) memory,
+    no L²×2L one-hot constant."""
+    length = t.shape[-1]
+    padded = jnp.pad(t, [(0, 0)] * (t.ndim - 2) + [(0, 0), (0, length)])
+    flat = padded.reshape(*t.shape[:-2], length * 2 * length)
+    flat = flat[..., : length * (2 * length - 1)]
+    skew = flat.reshape(*t.shape[:-2], length, 2 * length - 1)
+    return skew.sum(axis=-2)
+
+
+def _modmul_partial(a: jnp.ndarray, b: jnp.ndarray, fold_table, nl):
+    """Partial-form product: inputs ≤ nl+2 limbs (< 2^9·n), output partial.
+
+    Each anti-diagonal sums ≤ nl+2 products of 7-bit limbs —
+    (nl+2)·127² ≤ 4.8e6 for RSA-2048 (nl=293), inside int32."""
+    t = a[..., :, None].astype(jnp.int32) * b[..., None, :].astype(jnp.int32)
+    prod = _antidiagonal_sums(t)
+    prod = _normalize(jnp.pad(prod, [(0, 0)] * (prod.ndim - 1) + [(0, 5)]))
+    return _fold_partial(prod, fold_table, nl)
+
+
+def make_modmul(ctx: ModContext):
+    """Returns a jitted (a, b) → a·b mod n over (…, nlimbs) int limbs,
+    canonical output."""
+    fold_table = jnp.asarray(ctx.fold_table)
+    mod_shifts = jnp.asarray(ctx.mod_shifts)
+    nl = ctx.nlimbs
+
+    def modmul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+        out = _modmul_partial(a, b, fold_table, nl)
+        return _canonicalize(out, mod_shifts, nl)
+
+    return jax.jit(modmul)
+
+
+def make_modexp_65537(ctx: ModContext):
+    """Returns a jitted batched s → s^65537 mod n (the RSA verify exponent:
+    65537 = 2^16 + 1 ⇒ 16 squarings + 1 multiply).  The chain runs in
+    partial form; one canonicalization at the end."""
+    fold_table = jnp.asarray(ctx.fold_table)
+    mod_shifts = jnp.asarray(ctx.mod_shifts)
+    nl = ctx.nlimbs
+
+    def modexp(s: jnp.ndarray) -> jnp.ndarray:
+        pad_spec = [(0, 0)] * (s.ndim - 1) + [(0, 2)]
+        acc = jnp.pad(s.astype(jnp.int32), pad_spec)
+        base = acc
+
+        def square(acc, _):
+            return _modmul_partial(acc, acc, fold_table, nl), None
+
+        acc, _ = jax.lax.scan(square, acc, None, length=16)
+        out = _modmul_partial(acc, base, fold_table, nl)
+        return _canonicalize(out, mod_shifts, nl)
+
+    return jax.jit(modexp)
+
+
+# ---------------------------------------------------------------- host API
+
+
+@lru_cache(maxsize=8)
+def _cached_ctx(modulus: int) -> ModContext:
+    return ModContext.create(modulus)
+
+
+def modexp_65537_batch(signatures: list[int], modulus: int) -> list[int]:
+    """Batched s^65537 mod n on device; bit-identical to pow(s, 65537, n)."""
+    ctx = _cached_ctx(modulus)
+    fn = _cached_modexp(modulus)
+    limbs = ctx.to_device_limbs(signatures)
+    return ctx.from_device_limbs(fn(jnp.asarray(limbs)))
+
+
+@lru_cache(maxsize=8)
+def _cached_modexp(modulus: int):
+    return make_modexp_65537(_cached_ctx(modulus))

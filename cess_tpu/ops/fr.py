@@ -119,19 +119,22 @@ def _normalize(x: jnp.ndarray, passes: int = 6) -> jnp.ndarray:
 
 
 def _cond_sub_r(x: jnp.ndarray) -> jnp.ndarray:
-    """x (…, L) normalized limbs → where(x >= r, x - r, x)."""
+    """x (…, L) normalized limbs → where(x >= r, x - r, x).  Borrow
+    propagation runs as a lax.scan over the limb axis (unrolled chains make
+    compile time explode)."""
     length = x.shape[-1]
     r = np.zeros(length, dtype=np.int32)
     r[:NLIMBS] = _r_limbs()
     diff = x - jnp.asarray(r)
-    # Propagate borrows (static unrolled chain).
-    borrow = jnp.zeros(x.shape[:-1], dtype=jnp.int32)
-    outs = []
-    for i in range(length):
-        d = diff[..., i] - borrow
-        borrow = (d < 0).astype(jnp.int32)
-        outs.append(d + borrow * BASE)
-    sub = jnp.stack(outs, axis=-1)
+
+    def step(borrow, d):
+        d2 = d - borrow
+        b = (d2 < 0).astype(jnp.int32)
+        return b, d2 + b * BASE
+
+    borrow0 = jnp.zeros(x.shape[:-1], dtype=jnp.int32)
+    borrow, sub = jax.lax.scan(step, borrow0, jnp.moveaxis(diff, -1, 0))
+    sub = jnp.moveaxis(sub, 0, -1)
     ge = borrow == 0  # no final borrow ⇒ x >= r
     return jnp.where(ge[..., None], sub, x)
 
