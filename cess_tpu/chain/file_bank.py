@@ -503,33 +503,47 @@ class FileBankPallet:
     ) -> None:
         """Root/scheduler call: retry assignment ≤5 times, then refund
         (reference: lib.rs:498-538)."""
+        deal_info = self.deal_map.get(deal_hash)
+        ensure(deal_info is not None, MOD, "NonExistent")
         if count < 5:
-            deal_info = self.deal_map.get(deal_hash)
-            ensure(deal_info is not None, MOD, "NonExistent")
             for miner_task in deal_info.assigned_miner:
                 self.sminer.unlock_space(
                     miner_task.miner,
                     FRAGMENT_SIZE * len(miner_task.fragment_list),
                 )
-            deal_info.assigned_miner = self.random_assign_miner(
-                deal_info.needed_list
-            )
+            deal_info.assigned_miner = []
+            try:
+                new_assignment = self.random_assign_miner(
+                    deal_info.needed_list
+                )
+            except DispatchError:
+                # The reference executes this under #[transactional], so a
+                # failed re-assignment rolls back and the deal waits for the
+                # next scheduled retry; here the scheduler dispatch would
+                # swallow the error and leak the user's locked space, so
+                # terminate the deal through the refund path instead.
+                self._refund_deal(deal_hash, deal_info)
+                return
+            deal_info.assigned_miner = new_assignment
             deal_info.complete_list = []
             deal_info.count = count
             self.start_first_task(str(deal_hash), deal_hash, count + 1, life)
         else:
-            deal_info = self.deal_map.get(deal_hash)
-            ensure(deal_info is not None, MOD, "NonExistent")
-            needed_space = self.cal_file_size(len(deal_info.segment_list))
-            self.storage_handler.unlock_user_space(
-                deal_info.user.user, needed_space
+            self._refund_deal(deal_hash, deal_info)
+
+    def _refund_deal(self, deal_hash: Hash64, deal_info) -> None:
+        """Abandon a deal: release the user's and miners' locked space and
+        drop it (reference: lib.rs:520-536)."""
+        needed_space = self.cal_file_size(len(deal_info.segment_list))
+        self.storage_handler.unlock_user_space(
+            deal_info.user.user, needed_space
+        )
+        for miner_task in deal_info.assigned_miner:
+            self.sminer.unlock_space(
+                miner_task.miner,
+                FRAGMENT_SIZE * len(miner_task.fragment_list),
             )
-            for miner_task in deal_info.assigned_miner:
-                self.sminer.unlock_space(
-                    miner_task.miner,
-                    FRAGMENT_SIZE * len(miner_task.fragment_list),
-                )
-            del self.deal_map[deal_hash]
+        del self.deal_map[deal_hash]
 
     # ------------------------------------------------------------ storage
 

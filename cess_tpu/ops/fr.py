@@ -118,6 +118,22 @@ def _normalize(x: jnp.ndarray, passes: int = 6) -> jnp.ndarray:
     return x
 
 
+def _carry_exact(x: jnp.ndarray) -> jnp.ndarray:
+    """Exact carry propagation as a scan over the limb axis: output limbs
+    are strictly < 128 whatever the input chain looks like (the fixed-pass
+    _normalize only bounds limbs at <= 128, and a 128 can ripple through
+    any fixed number of passes over a run of 127s).  The caller guarantees
+    the value fits the limb count, so the final carry is zero."""
+
+    def step(carry, limb):
+        t = limb + carry
+        return t >> LIMB_BITS, t & (BASE - 1)
+
+    carry0 = jnp.zeros(x.shape[:-1], dtype=jnp.int32)
+    _, out = jax.lax.scan(step, carry0, jnp.moveaxis(x, -1, 0))
+    return jnp.moveaxis(out, 0, -1)
+
+
 def _cond_sub_r(x: jnp.ndarray) -> jnp.ndarray:
     """x (…, L) normalized limbs → where(x >= r, x - r, x).  Borrow
     propagation runs as a lax.scan over the limb axis (unrolled chains make
@@ -171,8 +187,11 @@ def _fold_to_canonical(x: jnp.ndarray) -> jnp.ndarray:
     x = x[..., : NLIMBS + 2]
     for _ in range(20):
         x = _cond_sub_r(x)
-    # canonical < r < 2^255 ⇒ limbs ≥ NLIMBS are provably zero.
-    return x[..., :NLIMBS]
+    # canonical < r < 2^255 ⇒ limbs ≥ NLIMBS are provably zero — but the
+    # fixed-pass normalize can leave an individual limb at exactly 128, so
+    # finish with an exact carry: every canonical output limb is < 128 and
+    # safe to recast to int8.
+    return _carry_exact(x[..., :NLIMBS])
 
 
 # int32 accumulator headroom: each anti-diagonal sums ≤ min(Lw,Lv) products

@@ -220,13 +220,21 @@ def _rhs_point(
 
 
 def verify(
-    pk: bytes, name: bytes, challenge: Challenge, proof: Podr2Proof
+    pk: bytes,
+    name: bytes,
+    challenge: Challenge,
+    proof: Podr2Proof,
+    s: int | None = None,
 ) -> bool:
-    """Single-proof pairing check."""
+    """Single-proof pairing check.  `s` pins the expected sector count; a
+    proof of any other μ width is rejected outright (malformed-input
+    handling must be identical across backends — consensus-critical)."""
     try:
         sigma = G1Point.from_bytes(proof.sigma)
         pk_point = G2Point.from_bytes(pk)
     except ValueError:
+        return False
+    if s is not None and len(proof.mu) != s:
         return False
     if any(not 0 <= m < R for m in proof.mu):
         return False
@@ -277,6 +285,7 @@ def batch_verify(
     items: list[BatchItem],
     seed: bytes,
     u_exponents: list[int] | None = None,
+    s: int | None = None,
 ) -> bool:
     """One combined check for N proofs under the same pk (module docstring
     equation).  Returns False if ANY proof in the batch is invalid; callers
@@ -284,7 +293,9 @@ def batch_verify(
 
     `u_exponents` lets a backend supply the device-computed
     Σ_b ρ_b μ_bj vector (same ρ derivation) — the single seam where the
-    xla backend differs from this host reference."""
+    xla backend differs from this host reference.  `s` pins the expected
+    sector count; when None it is derived from the first item (all items
+    must agree either way)."""
     if not items:
         return True
     try:
@@ -292,7 +303,8 @@ def batch_verify(
         sigmas = [G1Point.from_bytes(it.proof.sigma) for it in items]
     except ValueError:
         return False
-    s = len(items[0].proof.mu)
+    if s is None:
+        s = len(items[0].proof.mu)
     if any(len(it.proof.mu) != s for it in items):
         return False
     if any(not 0 <= m < R for it in items for m in it.proof.mu):

@@ -78,7 +78,9 @@ def _audit_step_local(
     # sectors: (b_local, C, S, Lm) → μ (b_local, S, 37)
     mu = fr.weighted_sum_kernel(v, jnp.moveaxis(sectors, 1, -2))
     # combine: contract local batch with local ρ then psum partials.
-    mu8 = mu.astype(jnp.int8)  # canonical limbs < 128 ⇒ exact in int8
+    # canonical limbs are strictly < 128 (fr._fold_to_canonical ends with
+    # an exact carry) ⇒ the int8 recast is lossless.
+    mu8 = mu.astype(jnp.int8)
     part = fr.weighted_sum_kernel(rho, jnp.moveaxis(mu8, 0, -2))  # (S, 37)
     total = jax.lax.psum(part, BATCH_AXIS)
     total = fr._normalize(

@@ -23,14 +23,17 @@ class CpuBackend(ProofBackend):
         seed: bytes,
         params: Podr2Params,
     ) -> list[bool]:
-        def batch_check(pk_, subset, seed_, _params):
+        def batch_check(pk_, subset, seed_, params_):
             return podr2.batch_verify(
-                pk_, [BatchItem(n, c, p) for n, c, p in subset], seed_
+                pk_,
+                [BatchItem(n, c, p) for n, c, p in subset],
+                seed_,
+                s=params_.s,
             )
 
-        def single_check(pk_, item, _params):
+        def single_check(pk_, item, params_):
             name, challenge, proof = item
-            return podr2.verify(pk_, name, challenge, proof)
+            return podr2.verify(pk_, name, challenge, proof, s=params_.s)
 
         return self._verdicts_by_bisection(
             pk, items, seed, params, batch_check, single_check

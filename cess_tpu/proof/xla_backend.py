@@ -58,7 +58,9 @@ class XlaBackend(ProofBackend):
             [fr.fr_to_limbs(p.mu) for _, _, p in items]
         )  # (B, S, 37)
         exps = fr.limbs_to_ints(fr.combine_mu(rhos, mu_limbs))
-        return podr2.batch_verify(pk, batch_items, seed, u_exponents=exps)
+        return podr2.batch_verify(
+            pk, batch_items, seed, u_exponents=exps, s=params.s
+        )
 
     def verify_batch(
         self,
@@ -67,9 +69,9 @@ class XlaBackend(ProofBackend):
         seed: bytes,
         params: Podr2Params,
     ) -> list[bool]:
-        def single_check(pk_, item, _params):
+        def single_check(pk_, item, params_):
             name, challenge, proof = item
-            return podr2.verify(pk_, name, challenge, proof)
+            return podr2.verify(pk_, name, challenge, proof, s=params_.s)
 
         return self._verdicts_by_bisection(
             pk, items, seed, params, self._combined_check, single_check

@@ -164,6 +164,21 @@ class TestDealLifecycle:
         for m in MINERS:
             assert rt.sminer.miner_items[m].lock_space == 0
 
+    def test_deal_reassign_refunds_when_no_miners_left(self):
+        # If re-assignment itself fails (all miners gone non-positive), the
+        # deal must terminate through the refund path instead of leaking the
+        # user's locked space with no retry scheduled.
+        rt = make_runtime()
+        file_hash, _, _ = declare(rt)
+        for m in MINERS:
+            rt.sminer.miner_items[m].state = "lock"
+        while file_hash in rt.file_bank.deal_map and rt.state.block_number < 5000:
+            rt.next_block()
+        assert file_hash not in rt.file_bank.deal_map
+        assert rt.storage_handler.user_owned_space["user"].locked_space == 0
+        for m in MINERS:
+            assert rt.sminer.miner_items[m].lock_space == 0
+
     def test_upload_needs_permission(self):
         rt = make_runtime()
         brief = UserBrief(user="user", file_name="fff", bucket_name="bkt-x")
