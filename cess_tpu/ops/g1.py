@@ -1,4 +1,4 @@
-"""Batched BLS12-381 G1 arithmetic on TPU: Fp limbs, Jacobian ops, MSM.
+"""Batched BLS12-381 G1 arithmetic on TPU: Fp limbs, complete point ops, MSM.
 
 The PoDR2 batch-verification equation (ops/podr2.py) needs three
 multi-scalar multiplications per batch — Π σ_b^{ρ_b} over the proofs,
@@ -8,29 +8,44 @@ verify in utils/verify-bls-signatures/src/lib.rs:85-100 and the audit seam
 at c-pallets/audit/src/lib.rs:484).  Those MSMs dominate the north-star
 workload; this module runs them on device.
 
-Design — no native big-int on TPU, so:
+Design — no native big-int on TPU, and XLA compile time grows with traced
+op count, so every choice below minimises both per-op work and graph size:
 
- * Fp elements are base-128 limb vectors (381 bits → 55 limbs), held
-   "loose": 56 int32 limbs, each in [0, 128), value < 2^385 + 256·p.
-   Multiplication is a 56-term shifted multiply-accumulate (int32 VPU ops,
-   every partial sum < 2^24); reduction folds limbs ≥ 55 through a
-   2^(7k) mod p table — two folds restore the loose bound, no per-op
-   carries or compares.
- * Canonicalization (rare: equality tests and host export) is a 9-step
-   conditional-subtraction ladder (256p … p) using a sign test on the
-   most-significant nonzero limb — parallel, no borrow scan — plus one
-   exact carry scan.
- * Points are Jacobian (X, Y, Z) limb batches; infinity is Z ≡ 0 (mod p).
-   Add/double are branchless: both paths are computed and the special
-   cases (either operand at infinity, equal or opposite inputs) resolved
-   with selects, so the kernel is data-oblivious and bit-identical to the
-   host reference ops/bls12_381.py for every input — including adversarial
-   proof points engineered to hit doubling/cancellation edges.
- * MSM = per-point MSB-first double-and-add (a lax.fori_loop over 255
-   bits, batch-vectorized) followed by a pairwise reduction tree — the
-   batch axis, not the bit loop, is where the parallelism lives.
+ * Fp elements are base-4096 limb vectors (381 bits → 32 limbs), held
+   "loose": 33 int32 limbs, each in [0, 4096], value < 2^384 + 8192·p.
+   Limb products of loose values fit int32 with headroom
+   (4096² · 33 < 2^29), so multiplication is a 33-term shifted
+   multiply-accumulate of static pads — no dynamic-update chains, which
+   XLA's CPU/TPU backends compile pathologically slowly.  Reduction folds
+   limbs ≥ 32 through a 2^(12k) mod p table (one small tensordot); two
+   folds restore the loose bound.  No carries are ever resolved exactly
+   on device — canonicalisation happens host-side at export, where
+   Python big-ints make it a one-liner.
+ * Subtraction is borrow-free: a fixed multiple of p is pre-decomposed
+   into limbs that are each ≥ 4096, so a + pad − b is non-negative in
+   every limb and the carry passes never see negatives.
+ * Arrays are limb-major — shape (33, N…) — so the batch axis fills TPU
+   vector lanes and every field op is a full-width VPU op.
+ * Point ops use the complete projective addition/doubling formulas for
+   a = 0 short-Weierstrass curves (Renes–Costello–Batina, EUROCRYPT
+   2016, Algorithms 7/9).  E(Fp) for BLS12-381 has odd order, so the
+   formulas are exception-free for EVERY input pair — including P = Q,
+   P = −Q, and the point at infinity (0 : 1 : 0).  The kernels therefore
+   contain no equality tests, no canonicalisation, and no special-case
+   selects: they are data-oblivious straight-line code, which is both
+   the fast shape for the VPU and the safe shape for adversarial proof
+   points engineered to hit doubling/cancellation edges.
+ * MSM = per-point MSB-first double-and-add (a lax.fori_loop over the
+   scalar bits, batch-vectorised) followed by a pairwise reduction tree
+   of complete adds.  The batch axis, not the bit loop, carries the
+   parallelism.  `bits` caps the ladder for known-narrow scalars (the
+   batch-verification ρ weights are 128-bit).  Batches are padded to a
+   power of two with (∞, 0) pairs so distinct jit compilations stay
+   logarithmic in the maximum batch size.
 
-Bit-identity against ops/bls12_381.py is asserted in tests/test_g1.py.
+Group-level bit-identity against the host reference ops/bls12_381.py
+(same affine coordinates out, for every input class) is asserted in
+tests/test_g1.py.
 """
 
 from __future__ import annotations
@@ -43,25 +58,27 @@ import numpy as np
 
 from .bls12_381 import G1Point, P, R
 
-LIMB_BITS = 7
+LIMB_BITS = 12
 BASE = 1 << LIMB_BITS
-NP_LIMBS = (381 + LIMB_BITS - 1) // LIMB_BITS  # 55 limbs hold an Fp value
-L = NP_LIMBS + 1  # loose representation length (value < 2^385 + 256p)
+NP_LIMBS = (381 + LIMB_BITS - 1) // LIMB_BITS  # 32 limbs hold an Fp value
+L = NP_LIMBS + 1  # loose representation length
 
-R_LIMBS = (255 + LIMB_BITS - 1) // LIMB_BITS  # 37 limbs hold a scalar < r
+R_LIMBS = (255 + LIMB_BITS - 1) // LIMB_BITS  # 22 limbs hold a scalar < r
 SCALAR_BITS = 255
+
+B3 = 12  # 3·b for y² = x³ + 4
 
 
 # ---------------------------------------------------------------- host codec
 
 
-def fp_to_limbs(x: int) -> np.ndarray:
-    out = np.zeros(L, dtype=np.int32)
-    for i in range(L):
+def fp_to_limbs(x: int, n: int = L) -> np.ndarray:
+    out = np.zeros(n, dtype=np.int32)
+    for i in range(n):
         out[i] = x & (BASE - 1)
         x >>= LIMB_BITS
     if x:
-        raise ValueError("value does not fit loose Fp limbs")
+        raise ValueError("value does not fit the requested limb count")
     return out
 
 
@@ -73,7 +90,7 @@ def limbs_to_fp(limbs) -> int:
 
 
 def scalars_to_limbs(scalars) -> np.ndarray:
-    """Scalars (< r) → (N, 37) int32 little-endian limbs."""
+    """Scalars (< r) → (N, 22) int32 little-endian limbs."""
     out = np.zeros((len(scalars), R_LIMBS), dtype=np.int32)
     for n, s in enumerate(scalars):
         s = int(s)
@@ -85,15 +102,15 @@ def scalars_to_limbs(scalars) -> np.ndarray:
     return out
 
 
-def points_to_jacobian(points) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Host G1Points → (X, Y, Z) limb arrays ((N, 56) int32 each).
-    Infinity encodes as (0, 1, 0) like the host reference."""
+def points_to_projective(points) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Host G1Points → (X, Y, Z) limb arrays ((N, 33) int32 each).
+    Infinity encodes as (0 : 1 : 0)."""
     n = len(points)
     X = np.zeros((n, L), dtype=np.int32)
     Y = np.zeros((n, L), dtype=np.int32)
     Z = np.zeros((n, L), dtype=np.int32)
     for i, pt in enumerate(points):
-        if pt.infinity:
+        if pt.is_infinity():
             Y[i] = fp_to_limbs(1)
         else:
             X[i] = fp_to_limbs(pt.x)
@@ -102,23 +119,34 @@ def points_to_jacobian(points) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     return X, Y, Z
 
 
-def jacobian_to_points(X, Y, Z) -> list[G1Point]:
-    """Canonical device limbs → host G1Points (host-side inversion)."""
+def projective_to_points(X, Y, Z) -> list[G1Point]:
+    """Loose device limbs → host G1Points.  Canonicalisation (mod p) and
+    the Z inversions run host-side; a Montgomery batch inversion turns N
+    modular inverses into 3N multiplications plus one modexp."""
     X, Y, Z = (np.asarray(a) for a in (X, Y, Z))
+    n = X.shape[0]
+    xs = [limbs_to_fp(X[i]) % P for i in range(n)]
+    ys = [limbs_to_fp(Y[i]) % P for i in range(n)]
+    zs = [limbs_to_fp(Z[i]) % P for i in range(n)]
+    # batch-invert the nonzero zs
+    idx = [i for i in range(n) if zs[i] != 0]
+    prefix = []
+    acc = 1
+    for i in idx:
+        prefix.append(acc)
+        acc = acc * zs[i] % P
+    inv = pow(acc, P - 2, P)
+    zinv = {}
+    for j in range(len(idx) - 1, -1, -1):
+        i = idx[j]
+        zinv[i] = inv * prefix[j] % P
+        inv = inv * zs[i] % P
     out = []
-    for i in range(X.shape[0]):
-        z = limbs_to_fp(Z[i]) % P
-        if z == 0:
+    for i in range(n):
+        if zs[i] == 0:
             out.append(G1Point.infinity())
-            continue
-        zinv = pow(z, P - 2, P)
-        z2 = zinv * zinv % P
-        out.append(
-            G1Point(
-                limbs_to_fp(X[i]) * z2 % P,
-                limbs_to_fp(Y[i]) * z2 % P * zinv % P,
-            )
-        )
+        else:
+            out.append(G1Point(xs[i] * zinv[i] % P, ys[i] * zinv[i] % P))
     return out
 
 
@@ -127,312 +155,343 @@ def jacobian_to_points(X, Y, Z) -> list[G1Point]:
 
 @lru_cache(maxsize=None)
 def _pow_table(start: int, count: int) -> np.ndarray:
-    """(count, 55) limbs of 2^(7k) mod p, k = start…start+count-1."""
+    """(count, 32) limbs of 2^(12k) mod p, k = start…start+count-1."""
     out = np.zeros((count, NP_LIMBS), dtype=np.int32)
     for k in range(count):
-        v = pow(2, LIMB_BITS * (start + k), P)
-        for i in range(NP_LIMBS):
-            out[k, i] = v & (BASE - 1)
-            v >>= LIMB_BITS
+        out[k] = fp_to_limbs(pow(2, LIMB_BITS * (start + k), P), NP_LIMBS)
     return out
-
-
-@lru_cache(maxsize=None)
-def _kp_ladder() -> np.ndarray:
-    """(9, L) limbs of k·p for k = 256, 128, …, 1 (canonicalization)."""
-    return np.stack([fp_to_limbs((1 << (8 - i)) * P) for i in range(9)])
 
 
 @lru_cache(maxsize=None)
 def _sub_pad() -> np.ndarray:
-    """Limbs of the smallest multiple of p ≥ 2^385 + 256p (subtraction
-    offset: a + pad - b stays non-negative for loose a, b)."""
-    bound = (1 << 385) + 256 * P
-    k = -(-bound // P)
-    return fp_to_limbs(k * P)
+    """Limbs of a multiple of p, each limb in [4096, 8192), covering the
+    loose bound: a + pad − b is non-negative in EVERY limb for loose a, b,
+    so subtraction never borrows."""
+    floor = sum(BASE << (LIMB_BITS * i) for i in range(L))  # all-4096 limbs
+    k = -(-floor // P) + 1
+    rem = k * P - floor
+    digits = fp_to_limbs(rem)  # each < 4096 by construction
+    if k * P >= 1 << (LIMB_BITS * (L + 1)):
+        raise AssertionError("sub pad exceeds one extra limb")
+    return digits + BASE
 
 
 # ---------------------------------------------------------------- Fp device
+# Field elements are (33, …) int32 arrays, limb-major.  All ops accept any
+# trailing batch shape.
 
 
-def _norm(x: jnp.ndarray, passes: int = 6) -> jnp.ndarray:
-    """Fixed carry passes: int32 limbs (|.| < 2^24 growth per pass is fine,
-    negative limbs use arithmetic-shift floor semantics) → limbs in
-    [0, 128] (a single limb may sit at exactly 128; the fold/canon steps
-    tolerate it)."""
+def _norm(x: jnp.ndarray, passes: int) -> jnp.ndarray:
+    """Value-preserving carry passes for NON-NEGATIVE limbs; callers pick
+    `passes` so the result limbs are ≤ 4096 (see per-op bounds)."""
     for _ in range(passes):
         low = x & (BASE - 1)
         carry = x >> LIMB_BITS
         x = low + jnp.pad(
-            carry[..., :-1], [(0, 0)] * (x.ndim - 1) + [(1, 0)]
+            carry[:-1], [(1, 0)] + [(0, 0)] * (x.ndim - 1)
         )
     return x
 
 
-def _fold_to_loose(x: jnp.ndarray) -> jnp.ndarray:
-    """Normalized limbs of any length ≥ 55 → loose (…, 56) limbs, value
-    < 2^385 + 256p, congruent mod p."""
-    for _ in range(2):
-        low, high = x[..., :NP_LIMBS], x[..., NP_LIMBS:]
-        if high.shape[-1] == 0:
-            x = jnp.pad(low, [(0, 0)] * (x.ndim - 1) + [(0, 2)])
-        else:
-            table = jnp.asarray(_pow_table(NP_LIMBS, high.shape[-1]))
-            folded = jax.lax.dot_general(
-                high,
-                table,
-                (((high.ndim - 1,), (0,)), ((), ())),
-                preferred_element_type=jnp.int32,
-            )
-            x = jnp.pad(
-                low + folded, [(0, 0)] * (x.ndim - 1) + [(0, 2)]
-            )
-        x = _norm(x)
-    return x[..., :L]
+def _fold(x: jnp.ndarray, rounds: int) -> jnp.ndarray:
+    """Normalized limbs (any length, each ≤ 4096) → loose (33, …) limbs,
+    congruent mod p.  Each round tensordots the limbs ≥ 32 against the
+    2^(12k) mod p table; callers pick `rounds` so the final value is
+    < 2^384 + 8192·p (one round per ~2^398 of input bound, two after a
+    full product).  The top limbs sliced off at the end are provably
+    zero for that bound."""
+    tail = [(0, 0)] * (x.ndim - 1)
+    for _ in range(rounds):
+        k = x.shape[0]
+        low, high = x[:NP_LIMBS], x[NP_LIMBS:]
+        table = jnp.asarray(_pow_table(NP_LIMBS, k - NP_LIMBS))
+        folded = jnp.tensordot(table.T, high, axes=1)  # (32, …)
+        x = jnp.pad(low, [(0, 2)] + tail) + jnp.pad(folded, [(0, 2)] + tail)
+        # dot sums ≤ 35·4096·4095 < 2^31; three passes restore ≤ 4096.
+        x = _norm(x, 3)
+    if x.shape[0] < L:
+        x = jnp.pad(x, [(0, L - x.shape[0])] + tail)
+    return x[:L]
 
 
 def _polymul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """(…, 56) × (…, 56) limb convolution → (…, 111) int32 (each
-    anti-diagonal sums ≤ 56 products < 2^14 ⇒ < 2^20, no overflow)."""
-    out = jnp.zeros((*a.shape[:-1], 2 * L - 1), dtype=jnp.int32)
-    for i in range(L):
-        out = out.at[..., i : i + L].add(a[..., i : i + 1] * b)
-    return out
+    """(33, …) × (33, …) limb convolution → (65, …) int32 via static pads
+    (each anti-diagonal sums ≤ 33 products ≤ 4096² ⇒ < 2^29)."""
+    tail = [(0, 0)] * (a.ndim - 1)
+    acc = jnp.pad(a[0:1] * b, [(0, L - 1)] + tail)
+    for i in range(1, L):
+        acc = acc + jnp.pad(a[i : i + 1] * b, [(i, L - 1 - i)] + tail)
+    return acc
 
 
 def mulm(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    # pad before normalizing: the top anti-diagonal can carry out (its sum
-    # is up to 56·127² ≈ 2^20, two limbs of headroom absorb the chain).
-    prod = _polymul(a, b)
-    prod = jnp.pad(prod, [(0, 0)] * (prod.ndim - 1) + [(0, 2)])
-    return _fold_to_loose(_norm(prod))
+    prod = jnp.pad(_polymul(a, b), [(0, 2)] + [(0, 0)] * (a.ndim - 1))
+    return _fold(_norm(prod, 3), rounds=2)
 
 
 def addm(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    s = jnp.pad(a + b, [(0, 0)] * (a.ndim - 1) + [(0, 1)])
-    return _fold_to_loose(_norm(s))
+    s = jnp.pad(a + b, [(0, 1)] + [(0, 0)] * (a.ndim - 1))
+    return _fold(_norm(s, 2), rounds=1)
 
 
 def subm(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    pad = jnp.asarray(_sub_pad())
-    s = jnp.pad(a + pad - b, [(0, 0)] * (a.ndim - 1) + [(0, 1)])
-    return _fold_to_loose(_norm(s))
+    pad = jnp.asarray(_sub_pad()).reshape((L,) + (1,) * (a.ndim - 1))
+    s = jnp.pad(a + pad - b, [(0, 1)] + [(0, 0)] * (a.ndim - 1))
+    return _fold(_norm(s, 2), rounds=1)
 
 
-def _scan_flags(gen: jnp.ndarray, prop: jnp.ndarray) -> jnp.ndarray:
-    """Carry-lookahead: given per-limb generate/propagate flags, return the
-    carry INTO each limb (log-depth associative scan, no sequential pass)."""
-
-    def combine(a, b):  # b is the later segment
-        ga, pa = a
-        gb, pb = b
-        return gb | (pb & ga), pa & pb
-
-    g_out, _ = jax.lax.associative_scan(
-        combine, (gen.astype(jnp.int32), prop.astype(jnp.int32)), axis=-1
-    )
-    # carry into limb i = carry out of prefix [0..i-1]
-    return jnp.pad(
-        g_out[..., :-1], [(0, 0)] * (gen.ndim - 1) + [(1, 0)]
-    )
-
-
-def _carry_fix(x: jnp.ndarray) -> jnp.ndarray:
-    """Limbs in [0, 128] (post-_norm) → strictly [0, 128), exactly."""
-    cin = _scan_flags(x == BASE, x == BASE - 1)
-    return (x + cin) & (BASE - 1)
-
-
-def _borrow_sub(x: jnp.ndarray, y: jnp.ndarray):
-    """Exact conditional subtract: both strictly normalized; returns
-    (x - y if x >= y else x, ge).  Borrow propagation is a carry-lookahead
-    scan on the per-limb differences."""
-    d = x - y
-    bin_ = _scan_flags(d < 0, d == 0)
-    out = d - bin_
-    bout_last = (out[..., -1] < 0).astype(jnp.int32)
-    out = out + (out < 0) * BASE
-    ge = bout_last == 0
-    return jnp.where(ge[..., None], out, x), ge
-
-
-def canon(x: jnp.ndarray) -> jnp.ndarray:
-    """Loose → canonical representative < p (exact limbs in [0, 128))."""
-    x = _carry_fix(_norm(x))
-    ladder = _kp_ladder()
-    for k in range(ladder.shape[0]):
-        x, _ = _borrow_sub(x, jnp.asarray(ladder[k]))
-    return x
-
-
-def is_zero(x: jnp.ndarray) -> jnp.ndarray:
-    """x ≡ 0 (mod p) for loose x → (…,) bool."""
-    return jnp.all(canon(x) == 0, axis=-1)
+def smallmul(a: jnp.ndarray, c: int) -> jnp.ndarray:
+    """a · c for a small positive constant (c ≤ 2^17 keeps int32 exact and
+    three carry passes restore limbs ≤ 4096)."""
+    s = jnp.pad(a * c, [(0, 2)] + [(0, 0)] * (a.ndim - 1))
+    return _fold(_norm(s, 3), rounds=1)
 
 
 # ---------------------------------------------------------------- points
-# A point batch is a (X, Y, Z) tuple of (…, 56) int32 limb arrays.
-
-
-def _select(cond, a, b):
-    return jnp.where(cond[..., None], a, b)
-
-
-def pt_double(p):
-    """dbl-2009-l (a = 0): branchless; infinity (Z ≡ 0) and y ≡ 0 inputs
-    propagate to Z3 ≡ 0 through the 2·Y·Z factor."""
-    X1, Y1, Z1 = p
-    A = mulm(X1, X1)
-    B = mulm(Y1, Y1)
-    C = mulm(B, B)
-    t = addm(X1, B)
-    D = mulm(t, t)
-    D = subm(D, addm(A, C))
-    D = addm(D, D)  # 2((X+B)^2 - A - C)
-    E = addm(addm(A, A), A)
-    F = mulm(E, E)
-    X3 = subm(F, addm(D, D))
-    C8 = addm(addm(C, C), addm(C, C))
-    C8 = addm(C8, C8)
-    Y3 = subm(mulm(E, subm(D, X3)), C8)
-    Z3 = mulm(addm(Y1, Y1), Z1)
-    return X3, Y3, Z3
+# A point batch is an (X, Y, Z) tuple of (33, …) limb arrays, projective
+# coordinates, infinity = (0 : 1 : 0).  Complete formulas: no cases.
 
 
 def pt_add(p, q):
-    """General Jacobian add (add-2007-bl) with branchless special cases:
-    p or q at infinity, p == q (falls through to double), p == -q
-    (infinity).  Cost: one add + one double + four canon comparisons."""
+    """Complete projective addition (Renes–Costello–Batina Alg. 7, a=0).
+    Exception-free on BLS12-381's odd-order E(Fp): handles P=Q, P=−Q and
+    infinity operands with no branches or selects."""
     X1, Y1, Z1 = p
     X2, Y2, Z2 = q
-    Z1Z1 = mulm(Z1, Z1)
-    Z2Z2 = mulm(Z2, Z2)
-    U1 = mulm(X1, Z2Z2)
-    U2 = mulm(X2, Z1Z1)
-    S1 = mulm(mulm(Y1, Z2), Z2Z2)
-    S2 = mulm(mulm(Y2, Z1), Z1Z1)
-    H = subm(U2, U1)
-    rr = subm(S2, S1)
-
-    h_zero = is_zero(H)
-    r_zero = is_zero(rr)
-    p_inf = is_zero(Z1)
-    q_inf = is_zero(Z2)
-
-    I = mulm(addm(H, H), addm(H, H))
-    J = mulm(H, I)
-    r2 = addm(rr, rr)
-    V = mulm(U1, I)
-    X3 = subm(mulm(r2, r2), addm(J, addm(V, V)))
-    Y3 = subm(mulm(r2, subm(V, X3)), addm(mulm(S1, J), mulm(S1, J)))
-    Z3 = mulm(mulm(addm(Z1, Z2), addm(Z1, Z2)), H)
-    Z3 = mulm(Z1, Z2)
-    Z3 = mulm(addm(Z3, Z3), H)
-
-    dX, dY, dZ = pt_double(p)
-
-    zero = jnp.zeros_like(X3)
-    # equal inputs → double; opposite → infinity (Z = exact 0)
-    is_dbl = h_zero & r_zero & ~p_inf & ~q_inf
-    is_inf_out = h_zero & ~r_zero & ~p_inf & ~q_inf
-    X3 = _select(is_dbl, dX, X3)
-    Y3 = _select(is_dbl, dY, Y3)
-    Z3 = _select(is_dbl, dZ, Z3)
-    Z3 = _select(is_inf_out, zero, Z3)
-    # either operand at infinity → the other
-    X3 = _select(q_inf, X1, _select(p_inf, X2, X3))
-    Y3 = _select(q_inf, Y1, _select(p_inf, Y2, Y3))
-    Z3 = _select(q_inf, Z1, _select(p_inf, Z2, Z3))
+    t0 = mulm(X1, X2)
+    t1 = mulm(Y1, Y2)
+    t2 = mulm(Z1, Z2)
+    t3 = mulm(addm(X1, Y1), addm(X2, Y2))
+    t3 = subm(t3, addm(t0, t1))  # X1Y2 + X2Y1
+    t4 = mulm(addm(Y1, Z1), addm(Y2, Z2))
+    t4 = subm(t4, addm(t1, t2))  # Y1Z2 + Y2Z1
+    ty = mulm(addm(X1, Z1), addm(X2, Z2))
+    ty = subm(ty, addm(t0, t2))  # X1Z2 + X2Z1
+    t0 = addm(addm(t0, t0), t0)  # 3·X1X2
+    t2 = smallmul(t2, B3)  # 3b·Z1Z2
+    Z3 = addm(t1, t2)  # Y1Y2 + 3bZ1Z2
+    t1 = subm(t1, t2)  # Y1Y2 − 3bZ1Z2
+    ty = smallmul(ty, B3)  # 3b(X1Z2 + X2Z1)
+    X3 = subm(mulm(t3, t1), mulm(t4, ty))
+    Y3 = addm(mulm(t1, Z3), mulm(ty, t0))
+    Z3 = addm(mulm(Z3, t4), mulm(t0, t3))
     return X3, Y3, Z3
+
+
+def pt_double(p):
+    """Complete projective doubling (RCB Alg. 9, a=0); same completeness
+    guarantees as pt_add, 3 fewer multiplications."""
+    X, Y, Z = p
+    t0 = mulm(Y, Y)
+    Z3 = addm(t0, t0)
+    Z3 = addm(Z3, Z3)
+    Z3 = addm(Z3, Z3)  # 8Y²
+    t1 = mulm(Y, Z)
+    t2 = smallmul(mulm(Z, Z), B3)  # 3bZ²
+    X3 = mulm(t2, Z3)  # 24bY²Z²
+    Y3 = addm(t0, t2)
+    Z3 = mulm(t1, Z3)  # 8Y³Z
+    t2 = addm(addm(t2, t2), t2)  # 9bZ²
+    t0 = subm(t0, t2)  # Y² − 9bZ²
+    Y3 = addm(X3, mulm(t0, Y3))
+    X3 = mulm(t0, mulm(X, Y))
+    X3 = addm(X3, X3)
+    return X3, Y3, Z3
+
+
+def _select(cond, a, b):
+    """cond: (…) bool over the batch shape; a, b: (33, …) limb arrays."""
+    return jnp.where(cond[None], a, b)
 
 
 # ---------------------------------------------------------------- MSM
 
 
 def _scalar_bit(scalars: jnp.ndarray, bit_index) -> jnp.ndarray:
-    """bit `bit_index` (traced) of (…, 37) limb scalars → (…,) int32."""
+    """bit `bit_index` (traced) of (22, …) limb-major scalars → (…) int32."""
     limb = jax.lax.dynamic_index_in_dim(
-        scalars, bit_index // LIMB_BITS, axis=scalars.ndim - 1, keepdims=False
+        scalars, bit_index // LIMB_BITS, axis=0, keepdims=False
     )
     return (limb >> (bit_index % LIMB_BITS)) & 1
 
 
-def batch_scalar_mul(points, scalars: jnp.ndarray):
-    """[s_i]P_i for a batch: MSB-first double-and-add over 255 bits.
+def batch_scalar_mul(points, scalars: jnp.ndarray, bits: int = SCALAR_BITS):
+    """[s_i]P_i for a batch: MSB-first double-and-add over `bits` bits.
 
-    points: (X, Y, Z) of (N, 56); scalars: (N, 37) limbs.  Returns a
-    Jacobian batch (N, 56)×3."""
+    points: (X, Y, Z) of (33, …); scalars: (22, …) limbs.  Returns a
+    projective batch.  `bits` caps the ladder for known-narrow scalars."""
     X, Y, Z = points
     zero = jnp.zeros_like(X)
-    one = jnp.zeros_like(X).at[..., 0].set(1)
+    one = zero.at[0].set(1)
 
     def body(i, acc):
-        aX, aY, aZ = pt_double(acc)
-        sX, sY, sZ = pt_add((aX, aY, aZ), (X, Y, Z))
-        bit = _scalar_bit(scalars, SCALAR_BITS - 1 - i) == 1
+        acc = pt_double(acc)
+        sX, sY, sZ = pt_add(acc, (X, Y, Z))
+        bit = _scalar_bit(scalars, bits - 1 - i) == 1
         return (
-            _select(bit, sX, aX),
-            _select(bit, sY, aY),
-            _select(bit, sZ, aZ),
+            _select(bit, sX, acc[0]),
+            _select(bit, sY, acc[1]),
+            _select(bit, sZ, acc[2]),
         )
 
     init = (zero, one, zero)  # infinity
-    return jax.lax.fori_loop(0, SCALAR_BITS, body, init)
+    return jax.lax.fori_loop(0, bits, body, init)
 
 
-def tree_reduce(points):
-    """Σ of a Jacobian batch by pairwise halving (log₂ N levels of batched
-    adds).  Returns a batch of size 1."""
+def tree_reduce(points, axis_size: int):
+    """Σ over the LAST batch axis (length `axis_size`, a power of two) by
+    pairwise halving — log₂ levels of complete adds, no special cases."""
     X, Y, Z = points
-    one = jnp.zeros((1, L), dtype=jnp.int32).at[0, 0].set(1)
-    while X.shape[0] > 1:
-        n = X.shape[0]
-        if n % 2:  # pad with infinity
-            X = jnp.concatenate([X, jnp.zeros((1, L), jnp.int32)])
-            Y = jnp.concatenate([Y, one])
-            Z = jnp.concatenate([Z, jnp.zeros((1, L), jnp.int32)])
-            n += 1
+    n = axis_size
+    while n > 1:
         h = n // 2
         X, Y, Z = pt_add(
-            (X[:h], Y[:h], Z[:h]), (X[h:], Y[h:], Z[h:])
+            (X[..., :h], Y[..., :h], Z[..., :h]),
+            (X[..., h:], Y[..., h:], Z[..., h:]),
         )
-    return X, Y, Z
+        n = h
+    return X[..., 0], Y[..., 0], Z[..., 0]
 
 
-@jax.jit
-def _msm_kernel(X, Y, Z, scalars):
-    acc = batch_scalar_mul((X, Y, Z), scalars)
-    rX, rY, rZ = tree_reduce(acc)
-    return canon(rX), canon(rY), canon(rZ)
+@partial(jax.jit, static_argnames=("bits", "group"))
+def _msm_kernel(X, Y, Z, scalars, bits=SCALAR_BITS, group=None):
+    """(33, N) inputs → per-group MSM.  group=None sums the whole batch
+    (result batch 1); group=g reshapes N = B·g and sums within groups."""
+    acc = batch_scalar_mul((X, Y, Z), scalars, bits=bits)
+    if group is not None:
+        n = X.shape[1]
+        acc = tuple(a.reshape(L, n // group, group) for a in acc)
+        return tree_reduce(acc, group)
+    return tree_reduce(tuple(a[:, None, :] for a in acc), X.shape[1])
 
 
-def msm(points: list[G1Point], scalars: list[int]) -> G1Point:
+def _pad_pow2(arrs: list[np.ndarray], n: int, axis: int = 0, y_index: int = 1):
+    """Pad point/scalar batches along `axis` to the next power of two with
+    (∞ = (0,1,0), scalar 0) entries; `y_index` names which array is the Y
+    coordinate (its padded rows get limb 0 = 1).  Returns (list, size)."""
+    m = 1 << max(0, (n - 1).bit_length())
+    if m == n:
+        return arrs, n
+    out = []
+    for k, a in enumerate(arrs):
+        pad = [(0, 0)] * a.ndim
+        pad[axis] = (0, m - n)
+        a = np.pad(a, pad)
+        if k == y_index:
+            sl = [slice(None)] * a.ndim
+            sl[axis] = slice(n, m)
+            a[tuple(sl)][..., 0] = 1
+        out.append(a)
+    return out, m
+
+
+def _prepare(points: list[G1Point], scalars: list[int], bits: int):
+    """Shared host preamble for the MSM entry points: validate, reduce
+    scalars mod r, enforce the bits cap, encode, pad the batch to a power
+    of two, and transpose to the limb-major device layout."""
+    if len(points) != len(scalars):
+        raise ValueError("points/scalars length mismatch")
+    scalars = [s % R for s in scalars]
+    if bits < SCALAR_BITS and any(s >> bits for s in scalars):
+        raise ValueError("scalar exceeds the bits cap")
+    X, Y, Z = points_to_projective(points)
+    s = scalars_to_limbs(scalars)
+    (X, Y, Z, s), m = _pad_pow2([X, Y, Z, s], len(points))
+    return (
+        jnp.asarray(X.T),
+        jnp.asarray(Y.T),
+        jnp.asarray(Z.T),
+        jnp.asarray(s.T),
+        m,
+    )
+
+
+def msm(
+    points: list[G1Point], scalars: list[int], bits: int = SCALAR_BITS
+) -> G1Point:
     """Π P_i^{s_i} on device — the batch-verification workhorse.
 
-    Bit-identical to folding G1Point.mul/add on host (tests/test_g1.py)."""
+    Group-level bit-identity with folding G1Point.mul/add on host is
+    asserted in tests/test_g1.py.  Every scalar must satisfy
+    s % r < 2^bits when `bits` caps the ladder."""
+    if not points:
+        if len(scalars):
+            raise ValueError("points/scalars length mismatch")
+        return G1Point.infinity()
+    X, Y, Z, s, _ = _prepare(points, scalars, bits)
+    rX, rY, rZ = _msm_kernel(X, Y, Z, s, bits=bits)
+    return projective_to_points(
+        np.asarray(rX).T, np.asarray(rY).T, np.asarray(rZ).T
+    )[0]
+
+
+def msm_grouped(
+    points: list[list[G1Point]],
+    scalars: list[list[int]],
+    bits: int = SCALAR_BITS,
+) -> list[G1Point]:
+    """Per-group MSMs in one device batch: result[b] = Π_i P[b][i]^{s[b][i]}.
+
+    The groups are padded to a common power-of-two width with (∞, 0)
+    pairs.  This is the shape of the verify path's H-side fold and the
+    prover's σ fold (47 challenged chunks per proof)."""
     if len(points) != len(scalars):
         raise ValueError("points/scalars length mismatch")
     if not points:
-        return G1Point.infinity()
-    X, Y, Z = points_to_jacobian(points)
-    s = scalars_to_limbs([s % R for s in scalars])
+        return []
+    width = max(len(g) for g in points)
+    g = 1 << max(0, (width - 1).bit_length())
+    B = len(points)
+    flatpts: list[G1Point] = []
+    flatsc: list[int] = []
+    inf = G1Point.infinity()
+    for prow, srow in zip(points, scalars):
+        if len(prow) != len(srow):
+            raise ValueError("group length mismatch")
+        flatpts.extend(prow)
+        flatpts.extend([inf] * (g - len(prow)))
+        flatsc.extend(srow)
+        flatsc.extend([0] * (g - len(srow)))
+    flatsc = [s % R for s in flatsc]
+    if bits < SCALAR_BITS and any(s >> bits for s in flatsc):
+        raise ValueError("scalar exceeds the bits cap")
+    X, Y, Z = points_to_projective(flatpts)
+    s = scalars_to_limbs(flatsc)
+    # pad the GROUP COUNT to a power of two as well (whole ∞ groups)
+    X = X.reshape(B, g, L)
+    Y = Y.reshape(B, g, L)
+    Z = Z.reshape(B, g, L)
+    s = s.reshape(B, g, R_LIMBS)
+    (X, Y, Z, s), Bp = _pad_pow2([X, Y, Z, s], B)
     rX, rY, rZ = _msm_kernel(
-        jnp.asarray(X), jnp.asarray(Y), jnp.asarray(Z), jnp.asarray(s)
+        jnp.asarray(X.reshape(Bp * g, L).T),
+        jnp.asarray(Y.reshape(Bp * g, L).T),
+        jnp.asarray(Z.reshape(Bp * g, L).T),
+        jnp.asarray(s.reshape(Bp * g, R_LIMBS).T),
+        bits=bits,
+        group=g,
     )
-    return jacobian_to_points(rX, rY, rZ)[0]
+    return projective_to_points(
+        np.asarray(rX).T[:B], np.asarray(rY).T[:B], np.asarray(rZ).T[:B]
+    )
 
 
-@jax.jit
-def _scalar_mul_canon_kernel(X, Y, Z, scalars):
-    rX, rY, rZ = batch_scalar_mul((X, Y, Z), scalars)
-    return canon(rX), canon(rY), canon(rZ)
+@partial(jax.jit, static_argnames=("bits",))
+def _scalar_mul_kernel(X, Y, Z, scalars, bits=SCALAR_BITS):
+    return batch_scalar_mul((X, Y, Z), scalars, bits=bits)
 
 
-def scalar_mul_batch(points: list[G1Point], scalars: list[int]) -> list[G1Point]:
+def scalar_mul_batch(
+    points: list[G1Point], scalars: list[int], bits: int = SCALAR_BITS
+) -> list[G1Point]:
     """[s_i]P_i per element, returned as host points (test/interop seam)."""
-    X, Y, Z = points_to_jacobian(points)
-    s = scalars_to_limbs([s % R for s in scalars])
-    rX, rY, rZ = _scalar_mul_canon_kernel(
-        jnp.asarray(X), jnp.asarray(Y), jnp.asarray(Z), jnp.asarray(s)
+    if not points:
+        if len(scalars):
+            raise ValueError("points/scalars length mismatch")
+        return []
+    n = len(points)
+    X, Y, Z, s, _ = _prepare(points, scalars, bits)
+    rX, rY, rZ = _scalar_mul_kernel(X, Y, Z, s, bits=bits)
+    return projective_to_points(
+        np.asarray(rX).T[:n], np.asarray(rY).T[:n], np.asarray(rZ).T[:n]
     )
-    return jacobian_to_points(rX, rY, rZ)
