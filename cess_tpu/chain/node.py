@@ -25,6 +25,7 @@ commitment, matching the reference's ≤ SigmaMax blobs
 from __future__ import annotations
 
 import hashlib
+import random
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -33,11 +34,12 @@ from ..ops import bls12_381 as bls
 from ..ops import podr2
 from ..ops.podr2 import Challenge, Podr2Params, Podr2Proof
 from ..ops.rs import segment_code
-from ..proof import ProofBackend, get_backend
+from ..proof import ProofBackend, get_backend, ias
 from ..proof.backend import ProveRequest
 from ..utils.hashing import Hash64
 from .file_bank import FillerInfo, SegmentList, UserBrief
 from .runtime import Runtime, RuntimeConfig
+from .tee_worker import SgxAttestationReport
 from .types import TOKEN
 
 
@@ -52,6 +54,34 @@ class StoredFragment:
 class MinerStore:
     fragments: dict[Hash64, StoredFragment] = field(default_factory=dict)
     fillers: dict[Hash64, StoredFragment] = field(default_factory=dict)
+
+
+from functools import lru_cache
+
+
+@lru_cache(maxsize=1)
+def _sim_authority():
+    """Deterministic fixture root, generated once per process (the RSA
+    prime search is ~0.1 s and the output is seed-fixed)."""
+    return ias.fixture_authority(random.Random(b"sim-ias-root"), bits=1024)
+
+
+@lru_cache(maxsize=8)
+def _sim_report(podr2_pbk: bytes):
+    """Deterministic attestation triple for a worker key, cached — every
+    NodeSim with the same key reproduces the identical report."""
+    _, root_priv = _sim_authority()
+    report_json = (
+        b'{"isvEnclaveQuoteStatus":"OK","podr2_pbk":"'
+        + podr2_pbk.hex().encode()
+        + b'"}'
+    )
+    return ias.fixture_report(
+        root_priv,
+        report_json,
+        random.Random(b"sim-tee-report" + podr2_pbk),
+        bits=1024,
+    )
 
 
 class NodeSim:
@@ -80,6 +110,20 @@ class NodeSim:
             },
         )
         cfg.podr2_chunk_count = params.n
+        # Attestation genesis: a fixture authority plays the Intel IAS
+        # root's role (reference pins the real root DER at
+        # primitives/enclave-verify/src/lib.rs:46-93); registration goes
+        # through the full X.509 + RSA verification path.  The fixture
+        # root is appended to any caller-pinned store so the sim's own
+        # TEE can still register under it.
+        self.ias_root_der, self.ias_root_priv = _sim_authority()
+        fixture_store = ias.RootStore.from_der([self.ias_root_der])
+        if cfg.ias_roots is None:
+            cfg.ias_roots = fixture_store
+        else:
+            cfg.ias_roots = ias.RootStore(
+                tuple(cfg.ias_roots.roots) + fixture_store.roots
+            )
         self.rt = Runtime(cfg)
         self.rt.run_blocks(1)
 
@@ -92,7 +136,8 @@ class NodeSim:
         node_key = bls.sk_to_pk(self.tee_node_sk)
         self.rt.staking.bond("tee-stash", self.tee_acc, 100_000 * TOKEN)
         self.rt.tee_worker.register(
-            self.tee_acc, "tee-stash", node_key, b"tee-peer", self.tee_pk, None
+            self.tee_acc, "tee-stash", node_key, b"tee-peer", self.tee_pk,
+            self.make_attestation(self.tee_pk),
         )
         self.rt.audit.result_verifier = lambda nk, msg, sig: bls.verify(
             nk, msg, sig
@@ -110,6 +155,17 @@ class NodeSim:
         self._rs = segment_code()
 
     # ------------------------------------------------------------ helpers
+
+    def make_attestation(self, podr2_pbk: bytes) -> SgxAttestationReport:
+        """Fabricate an attestation report signed under the sim's pinned
+        authority (the reference's own tests round-trip fixtures the same
+        way, enclave-verify/src/lib.rs:242-255).  The report body binds
+        the worker's PoDR2 public key (checked at registration —
+        proof/ias.report_binds_key)."""
+        sign, cert_b64, report = _sim_report(podr2_pbk)
+        return SgxAttestationReport(
+            report_json_raw=report, sign=sign, cert_der=cert_b64
+        )
 
     @property
     def segment_bytes(self) -> int:

@@ -49,6 +49,11 @@ class RuntimeConfig:
     podr2_chunk_count: int = 1024               # CHUNK_COUNT (common lib.rs:62)
     genesis_randomness: bytes = bytes(32)
     endowed: dict = field(default_factory=dict)  # account -> free balance
+    # Pinned attestation trust anchors (proof/ias.RootStore).  None skips
+    # the attestation gate (unit-test pallets in isolation); the node sim
+    # always pins a root (reference pins Intel's at
+    # primitives/enclave-verify/src/lib.rs:46-93).
+    ias_roots: object | None = None
 
 
 class Runtime:
@@ -72,8 +77,17 @@ class Runtime:
         self.staking = StakingPallet(
             self.state, self.sminer, eras_per_year=cfg.eras_per_year
         )
+        cert_verifier = None
+        if cfg.ias_roots is not None:
+            from ..proof import ias as _ias
+
+            cert_verifier = lambda sign, cert, report, pbk: (  # noqa: E731
+                _ias.report_binds_key(report, pbk)
+                and _ias.verify_attestation(sign, cert, report, cfg.ias_roots)
+            )
         self.tee_worker = TeeWorkerPallet(
-            self.state, self.staking, self.scheduler_credit
+            self.state, self.staking, self.scheduler_credit,
+            cert_verifier=cert_verifier,
         )
         self.file_bank = FileBankPallet(
             self.state,

@@ -50,12 +50,14 @@ class TeeWorkerPallet:
         state: ChainState,
         staking,
         credit_counter,
-        cert_verifier: Callable[[bytes, bytes, bytes], bool] | None = None,
+        cert_verifier: Callable[[bytes, bytes, bytes, bytes], bool] | None = None,
     ) -> None:
         self.state = state
         self.staking = staking
         self.credit_counter = credit_counter
-        # verify(sign, cert_der, report_json) -> bool
+        # verify(sign, cert_der, report_json, podr2_pbk) -> bool; the last
+        # argument lets the verifier check the report BINDS the submitted
+        # key (replay of someone else's valid attestation must fail).
         self.cert_verifier = cert_verifier
         self.tee_worker_map: dict[AccountId, TeeWorkerInfo] = {}
         self.tee_podr2_pk: bytes | None = None
@@ -83,6 +85,7 @@ class TeeWorkerPallet:
                     sgx_attestation_report.sign,
                     sgx_attestation_report.cert_der,
                     sgx_attestation_report.report_json_raw,
+                    podr2_pbk,
                 ),
                 MOD,
                 "VerifyCertFailed",

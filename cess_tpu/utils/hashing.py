@@ -7,8 +7,8 @@ protocol layer (`Hash64`) because deal/file identity, dedup, and restoral
 orders all key on it.
 
 Hashing stays on the host CPU (SURVEY.md §7: only field/coding math goes to
-TPU); a vmapped JAX SHA-256 lives in ops/sha256_jax.py for on-device Merkle
-work and is tested bit-identical against this module.
+TPU); the C++ native core (native/chaincore.cpp) carries bit-identical
+SHA-256/BLAKE2b for the host runtime path, tested in tests/test_native.py.
 """
 
 from __future__ import annotations

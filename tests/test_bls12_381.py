@@ -191,3 +191,92 @@ class TestSignatures:
         assert not bls.verify(pk, b"m", sig[:-1])       # short sig
         assert not bls.verify(pk[:-1], b"m", sig)       # short key
         assert not bls.verify(pk, b"m", b"\x00" * 48)   # invalid point
+
+
+class TestReferenceKATs:
+    """Known-answer vectors mirrored verbatim from the reference
+    (utils/verify-bls-signatures/tests/tests.rs) — the bit-identicality
+    anchor for the whole hash-to-curve + pairing pipeline (SURVEY.md §4).
+    These are IC threshold-BLS vectors: G1 signatures under the suite
+    BLS_SIG_BLS12381G1_XMD:SHA-256_SSWU_RO_NUL_."""
+
+    # tests.rs:19-33 (valid) and 36-50 (mismatched pairs)
+    SIG_A = bytes.fromhex(
+        "ace9fcdd9bc977e05d6328f889dc4e7c99114c737a494653cb27a1f55c06f455"
+        "5e0f160980af5ead098acc195010b2f7"
+    )
+    MSG_A = bytes.fromhex(
+        "0d69632d73746174652d726f6f74e6c01e909b4923345ce5970962bcfe3004bf"
+        "d8474a21dae28f50692502f46d90"
+    )
+    KEY_A = bytes.fromhex(
+        "814c0e6ec71fab583b08bd81373c255c3c371b2e84863c98a4f1e08b74235d14"
+        "fb5d9c0cd546d9685f913a0c0b2cc5341583bf4b4392e467db96d65b9bb4cb71"
+        "7112f8472e0d5a4d14505ffd7484b01291091c5f87b98883463f98091a0baaae"
+    )
+    SIG_B = bytes.fromhex(
+        "89a2be21b5fa8ac9fab1527e041327ce899d7da971436a1f2165393947b4d942"
+        "365bfe5488710e61a619ba48388a21b1"
+    )
+    MSG_B = bytes.fromhex(
+        "0d69632d73746174652d726f6f74b294b418b11ebe5dd7dd1dcb099e4e0372b9"
+        "a42aef7a7a37fb4f25667d705ea9"
+    )
+    KEY_B = bytes.fromhex(
+        "9933e1f89e8a3c4d7fdcccdbd518089e2bd4d8180a261f18d9c247a52768ebce"
+        "98dc7328a39814a8f911086a1dd50cbe015e2a53b7bf78b55288893daa15c346"
+        "640e8831d72a12bdedd979d28470c34823b8d1c3f4795d9c3984a247132e94fe"
+    )
+
+    def test_verify_valid(self):
+        assert bls.verify_bls_signature(self.SIG_A, self.MSG_A, self.KEY_A)
+        assert bls.verify_bls_signature(self.SIG_B, self.MSG_B, self.KEY_B)
+
+    def test_reject_invalid(self):
+        # tests.rs:36-50: signature/message/key cross-pairings
+        assert not bls.verify_bls_signature(self.SIG_B, self.MSG_A, self.KEY_A)
+        assert not bls.verify_bls_signature(self.SIG_A, self.MSG_B, self.KEY_B)
+
+    def test_reject_invalid_sig_point(self):
+        # tests.rs:53-60: sig is not a valid point (last byte perturbed)
+        bad = self.SIG_A[:-1] + bytes([self.SIG_A[-1] ^ 0x0F])
+        assert not bls.verify_bls_signature(bad, self.MSG_A, self.KEY_A)
+
+    def test_reject_invalid_key_point(self):
+        # tests.rs:63-71: key is not a valid point (last byte perturbed)
+        bad = self.KEY_A[:-1] + bytes([self.KEY_A[-1] ^ 0x03])
+        assert not bls.verify_bls_signature(self.SIG_A, self.MSG_A, bad)
+
+    def test_accepts_known_good_signature(self):
+        # tests.rs:96-104
+        key = bytes.fromhex(
+            "87033f48fd8f327ff5d164e85af31433c6a8c73fc5a65bad5d472127205c73c5"
+            "168a45e862f5af6d0da5676df45d0a5f1293a530d5498f812a34a280f6bef869"
+            "e4ca9b7c275554456d8770733d72ac4006777382fa541873fe002adb12184268"
+        )
+        msg = bytes.fromhex(
+            "e751fdb69185002b13c8d2954c7d0c39546402ecdde9c2a9a2c6242935"
+            "35a5ca2f560a582f705580448fbe1ccdc0e86af3ba4c487a7f73bc9c312556"
+        )
+        sig = bytes.fromhex(
+            "98733cc2b312d5787cd4dba6ea0e19a1f1850b9e8c6d5112f12e12db8e7413a4"
+            "ecb4096c23730566c67d9b2694e4e179"
+        )
+        assert bls.verify_bls_signature(sig, msg, key)
+
+    def test_generates_expected_signature(self):
+        # tests.rs:107-127: sign with a published secret key and compare
+        sk = int(
+            "6f3977f6051e184b2c412daa1b5c0115ef7ab347cac8d808ffa2c26bd0658243",
+            16,
+        )
+        msg = bytes.fromhex(
+            "50484522ad8aede64ec7f86b9273b7ed3940481acf93cdd40a2b77f2be2734a1"
+            "4012b2492b6363b12adaeaf055c573e4611b085d2e0fe2153d72453a95eaebf3"
+            "50ac3ba6a26ba0bc79f4c0bf5664dfdf5865f69f7fc6b58ba7d068e8"
+        )
+        expected = (
+            "8f7ad830632657f7b3eae17fd4c3d9ff5c13365eea8d33fd0a1a6d8fbebc5152"
+            "e066bb0ad61ab64e8a8541c8e3f96de9"
+        )
+        assert bls.sign(sk, msg).hex() == expected

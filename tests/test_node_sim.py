@@ -108,3 +108,56 @@ class TestAuditRound:
         idle_ok, service_ok = results[corrupted]
         assert idle_ok  # fillers untouched
         assert not service_ok  # corrupted data cannot prove
+
+
+class TestXlaBackendRound:
+    """The full protocol loop with backend="xla": every G1 MSM in prove
+    and verify runs through the ops/g1.py device kernels (VERDICT r2 done
+    criterion: no G1 MSM in the verify path executes in host Python)."""
+
+    def test_honest_round_on_xla_backend(self):
+        sim = NodeSim(
+            n_miners=5, n_validators=3, backend="xla", params=PARAMS
+        )
+        for m in sim.miners:
+            sim.miner_add_fillers(m, 26)
+        sim.add_user("ursula")
+        content = bytes((i * 13 + 5) % 256 for i in range(1500))
+        sim.user_upload("ursula", "ledger.bin", content)
+        sim.rt.staking.end_era()
+        results = sim.run_audit_round()
+        assert results, "no miners challenged"
+        for miner, (idle_ok, service_ok) in results.items():
+            assert idle_ok and service_ok
+            assert sim.rt.sminer.reward_map[miner].total_reward > 0
+
+    def test_xla_detects_corruption(self):
+        sim = NodeSim(
+            n_miners=5, n_validators=3, backend="xla", params=PARAMS
+        )
+        for m in sim.miners:
+            sim.miner_add_fillers(m, 26)
+        sim.add_user("vera")
+        content = bytes((i * 7 + 1) % 256 for i in range(1500))
+        sim.user_upload("vera", "notes.bin", content)
+        corrupted = None
+        results = None
+        for _ in range(10):
+            if corrupted is None:
+                for m in sim.miners:
+                    if sim.store[m].fragments:
+                        corrupted = m
+                        for frag in sim.store[m].fragments.values():
+                            frag.data = bytes(b ^ 0xFF for b in frag.data)
+                        break
+            sim.rt.audit.challenge_snap_shot = None
+            sim.rt.audit.challenge_duration = 0
+            sim.rt.audit.verify_duration = 0
+            sim.rt.next_block()
+            results = sim.run_audit_round()
+            if corrupted in results:
+                break
+        assert corrupted in results, "corrupted miner never challenged"
+        idle_ok, service_ok = results[corrupted]
+        assert idle_ok
+        assert not service_ok

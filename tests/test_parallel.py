@@ -70,3 +70,37 @@ def test_sharded_equals_single_device_kernel():
     sharded = combine_mu_sharded(mesh, fr.ints_to_limbs(rhos, 19), mu_limbs)
     single = fr.combine_mu(rhos, mu_limbs)
     assert np.array_equal(np.asarray(sharded), np.asarray(single))
+
+
+def test_verify_batch_sharded_matches_single_device():
+    """ProofBackend.verify_batch driven through an 8-device mesh: the
+    mesh-routed combine must produce IDENTICAL verdicts to the
+    single-device xla backend and the cpu reference (VERDICT r2 ask 5 —
+    the sharded data plane as the production path, not a demo)."""
+    from cess_tpu.ops import podr2
+    from cess_tpu.ops.podr2 import Challenge, Podr2Params
+    from cess_tpu.proof import CpuBackend, XlaBackend
+
+    params = Podr2Params(n=8, s=4)
+    sk, pk = podr2.keygen(b"sharded-tee")
+    ch = Challenge(
+        indices=(0, 3, 5),
+        randoms=tuple(
+            bytes([i]) * 20 for i in range(3)
+        ),
+    )
+    items = []
+    for k in range(5):  # 5 proofs: not a multiple of 8 → exercises padding
+        name = f"frag-{k}".encode()
+        data = bytes([(k * 31 + i) % 256 for i in range(params.fragment_bytes)])
+        tags = podr2.tag_fragment(sk, name, data, params)
+        proof = podr2.prove(tags, data, ch, params)
+        if k == 3:
+            proof.mu[0] = (proof.mu[0] + 1) % podr2.R  # corrupt one
+        items.append((name, ch, proof))
+
+    mesh = make_mesh(8)
+    sharded = XlaBackend(mesh=mesh).verify_batch(pk, items, b"seed", params)
+    single = XlaBackend().verify_batch(pk, items, b"seed", params)
+    cpu = CpuBackend().verify_batch(pk, items, b"seed", params)
+    assert sharded == single == cpu == [True, True, True, False, True]

@@ -1,0 +1,65 @@
+"""RSA PKCS#1 v1.5 SHA-256: host path vs batched device path bit-identity
+(ops/rsa.py; capability match: primitives/enclave-verify/src/lib.rs:221-228
+and the webpki RSA_PKCS1_2048_8192_SHA256 check at lib.rs:165-169)."""
+
+import random
+
+from cess_tpu.ops import rsa
+
+RNG = random.Random(0x52)
+KEY = rsa.keygen(1024, RNG)
+PUB = KEY.public()
+
+
+def test_sign_verify_roundtrip():
+    msg = b"attestation report body"
+    sig = rsa.sign(KEY, msg)
+    assert rsa.verify(PUB, msg, sig)
+
+
+def test_wrong_message_rejected():
+    sig = rsa.sign(KEY, b"genuine")
+    assert not rsa.verify(PUB, b"forged", sig)
+
+
+def test_tampered_signature_rejected():
+    sig = bytearray(rsa.sign(KEY, b"msg"))
+    sig[-1] ^= 1
+    assert not rsa.verify(PUB, b"msg", bytes(sig))
+
+
+def test_wrong_length_and_range_rejected():
+    sig = rsa.sign(KEY, b"msg")
+    assert not rsa.verify(PUB, b"msg", sig[:-1])
+    assert not rsa.verify(PUB, b"msg", sig + b"\x00")
+    too_big = (PUB.n + 1).to_bytes(PUB.size_bytes, "big")
+    assert not rsa.verify(PUB, b"msg", too_big)
+
+
+def test_batch_bit_identity_with_host():
+    msgs = [f"report-{i}".encode() for i in range(6)]
+    pairs = []
+    for i, m in enumerate(msgs):
+        sig = rsa.sign(KEY, m)
+        if i == 2:
+            sig = sig[:-1] + bytes([sig[-1] ^ 0xFF])  # corrupt
+        if i == 4:
+            m = b"swapped"  # mismatched message
+        pairs.append((m, sig))
+    want = [rsa.verify(PUB, m, s) for m, s in pairs]
+    got = rsa.verify_batch(PUB, pairs)
+    assert got == want
+    assert want == [True, True, False, True, False, True]
+
+
+def test_batch_empty():
+    assert rsa.verify_batch(PUB, []) == []
+
+
+def test_batch_non_f4_falls_back():
+    key = rsa.RsaPrivateKey(n=KEY.n, e=3, d=0)  # only the e matters here
+    pub = rsa.RsaPublicKey(KEY.n, 3)
+    sig = b"\x01" * pub.size_bytes
+    assert rsa.verify_batch(pub, [(b"m", sig)]) == [
+        rsa.verify(pub, b"m", sig)
+    ]

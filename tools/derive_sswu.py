@@ -1,36 +1,39 @@
-"""Derive the RFC 9380 G1 SSWU isogeny for BLS12-381 from first principles.
+"""Derive the RFC 9380 G1 SSWU isogeny for BLS12-381 and emit it as
+cess_tpu/ops/_sswu_g1.py.
 
 The simplified-SWU map for BLS12-381 G1 targets an auxiliary curve
-E': y^2 = x^3 + A'x + B' that is 11-isogenous to E: y^2 = x^3 + 4, followed
-by an 11-isogeny E' -> E.  The RFC publishes E' and the isogeny as ~50 large
-hex constants; this script *derives* them instead of trusting transcription:
+E': y^2 = x^3 + A'x + B' that is 11-isogenous to E: y^2 = x^3 + 4,
+followed by an 11-isogeny E' -> E.  The RFC publishes the isogeny as ~50
+large hex constants; this script derives them from the ciphersuite
+parameters (A', B', Z) instead of transcribing them:
 
-  1. build the 11-division polynomial of E (degree 60) over Fp;
-  2. factor out the two order-11 rational-subgroup kernel polynomials
-     (degree 5) via x^(p^k) mod psi_11 power maps + Cantor-Zassenhaus;
-  3. run Velu/Kohel's formulas (power sums + the P*h' mod h trick for
-     sums over kernel roots) to get, for each kernel, the codomain curve
-     E2 and the rational maps of the isogeny E -> E2;
-  4. on E2, repeat 1-3 to find the dual direction E2 -> E3 ~ E and the
-     scaling back to y^2 = x^3 + 4;
-  5. enumerate the finitely many Fp-normalizations (sqrt/6th-root choices
-     = Aut(E) and the two kernels) of the composite
-       SSWU(A',B',Z=11) -> E' -> E2 -> E3 -> E
-     and select the unique candidate that reproduces the IC known-answer
-     signature vectors carried by the reference
-     (/root/reference/utils/verify-bls-signatures/tests/tests.rs:96-127).
+  1. build the 11-division polynomial psi_11 of E' (degree 60) over Fp;
+  2. split off the rational kernel polynomial(s) h (degree 5) with
+     gcd(x^p - x, psi_11) plus an equal-degree split when both order-11
+     subgroups are rational;
+  3. run Velu's formulas symbolically: the kernel-root sums
+     sum_i tau(x_i) * h(x)/(x - x_i) are computed as (tau * h') mod h
+     (interpolation at the roots), so no root extraction is needed; this
+     yields the codomain E2: y^2 = x^3 + B2 and the normalized maps
+       phi_x = N/h^2,  phi_y = y * d(phi_x)/dx;
+  4. scale E2 onto E with (x, y) -> (x/w^2, y/w^3), w^6 = B2/4 (sixth
+     roots via sqrt + a 3-Sylow discrete-log cube root);
+  5. the remaining finite ambiguity (<= 2 kernels x 6 roots w) is
+     resolved by the IC known-answer vectors carried by the reference
+     (/root/reference/utils/verify-bls-signatures/tests/tests.rs:96-127):
+     the unique candidate that re-generates the expected signature from
+     the published secret key is emitted.
 
-The only constant taken on faith is A' (checked, with everything else, by
-the 128-bit-strength KAT); B' and all isogeny coefficients come out of the
-algebra.  Results are emitted as cess_tpu/ops/_sswu_g1.py.
+Everything downstream of (A', B', Z) is derived, and the KAT pins the
+whole pipeline (expand_message_xmd, SSWU, isogeny, cofactor clearing,
+point compression) to 128-bit strength.
 
-Run:  python tools/derive_sswu.py          (stage results cached in
-      tools/_sswu_cache.json; a full cold run takes a few minutes)
+Run:  python tools/derive_sswu.py     (~1 minute; writes
+      cess_tpu/ops/_sswu_g1.py and prints the selected normalization)
 """
 
 from __future__ import annotations
 
-import json
 import os
 import sys
 
@@ -39,25 +42,47 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 from cess_tpu.ops import bls12_381 as bls  # noqa: E402
 from cess_tpu.ops.bls12_381 import P  # noqa: E402
 
-CACHE = os.path.join(os.path.dirname(__file__), "_sswu_cache.json")
-
-# RFC 9380 §8.8.1 ciphersuite parameters for BLS12381G1 (the one recalled
-# input; everything downstream is derived and KAT-verified).
+# RFC 9380 §8.8.1 ciphersuite parameters for BLS12381G1_XMD:SHA-256_SSWU_RO
+# (KAT-verified along with everything derived from them).
 A_PRIME = int(
     "0x144698a3b8e9433d693a02c96d4982b0ea985383ee66a8d8e8981aef"
     "d881ac98936f8da0e0f97f5cf428082d584c1d",
     16,
 )
+B_PRIME = int(
+    "0x12e2908d11688030018b12e8753eee3b2016c1f0f24f4070a0b9c14f"
+    "cef35ef55a23215a316ceaa5d1cc48e98e172be0",
+    16,
+)
 Z_SSWU = 11
 
-A_E, B_E = 0, 4  # E: y^2 = x^3 + 4
+IC_DST = b"BLS_SIG_BLS12381G1_XMD:SHA-256_SSWU_RO_NUL_"
+
+# RFC 9380 §8.8.1 effective cofactor for G1: h_eff = 1 − z (NOT the full
+# cofactor (z−1)²/3 — they differ by a scalar multiple on the r-torsion).
+H_EFF = 0xD201000000010001
+
+# KAT: "generates_expected_signature" from the reference tests
+# (utils/verify-bls-signatures/tests/tests.rs:114-127).
+KAT_SK = int(
+    "6f3977f6051e184b2c412daa1b5c0115ef7ab347cac8d808ffa2c26bd0658243", 16
+)
+KAT_MSG = bytes.fromhex(
+    "50484522ad8aede64ec7f86b9273b7ed3940481acf93cdd40a2b77f2be2734a1"
+    "4012b2492b6363b12adaeaf055c573e4611b085d2e0fe2153d72453a95eaebf3"
+    "50ac3ba6a26ba0bc79f4c0bf5664dfdf5865f69f7fc6b58ba7d068e8"
+)
+KAT_SIG = bytes.fromhex(
+    "8f7ad830632657f7b3eae17fd4c3d9ff5c13365eea8d33fd0a1a6d8fbebc5152"
+    "e066bb0ad61ab64e8a8541c8e3f96de9"
+)
 
 
 # ---------------------------------------------------------------- Fp polys
 # Dense little-endian coefficient lists over Fp.
 
 
-def pstrip(f):
+def ptrim(f):
     while f and f[-1] == 0:
         f.pop()
     return f
@@ -65,18 +90,22 @@ def pstrip(f):
 
 def padd(f, g):
     n = max(len(f), len(g))
-    return pstrip([
-        ((f[i] if i < len(f) else 0) + (g[i] if i < len(g) else 0)) % P
-        for i in range(n)
-    ])
+    out = [0] * n
+    for i, c in enumerate(f):
+        out[i] = c
+    for i, c in enumerate(g):
+        out[i] = (out[i] + c) % P
+    return ptrim(out)
 
 
 def psub(f, g):
     n = max(len(f), len(g))
-    return pstrip([
-        ((f[i] if i < len(f) else 0) - (g[i] if i < len(g) else 0)) % P
-        for i in range(n)
-    ])
+    out = [0] * n
+    for i, c in enumerate(f):
+        out[i] = c
+    for i, c in enumerate(g):
+        out[i] = (out[i] - c) % P
+    return ptrim(out)
 
 
 def pmul(f, g):
@@ -87,39 +116,25 @@ def pmul(f, g):
         if a:
             for j, b in enumerate(g):
                 out[i + j] = (out[i + j] + a * b) % P
-    return pstrip(out)
+    return ptrim(out)
 
 
 def pscale(f, c):
     c %= P
-    return pstrip([a * c % P for a in f])
-
-
-def pdivmod(f, g):
-    """Polynomial division with remainder (g nonzero)."""
-    f = list(f)
-    q = [0] * max(0, len(f) - len(g) + 1)
-    ginv = pow(g[-1], P - 2, P)
-    while len(f) >= len(g) and pstrip(f):
-        if not f:
-            break
-        c = f[-1] * ginv % P
-        d = len(f) - len(g)
-        q[d] = c
-        for i, b in enumerate(g):
-            f[i + d] = (f[i + d] - c * b) % P
-        pstrip(f)
-    return pstrip(q), pstrip(f)
+    return ptrim([a * c % P for a in f])
 
 
 def pmod(f, g):
-    return pdivmod(f, g)[1]
-
-
-def pexactdiv(f, g):
-    q, r = pdivmod(f, g)
-    assert not r, "division expected to be exact"
-    return q
+    f = list(f)
+    ginv = pow(g[-1], P - 2, P)
+    dg = len(g) - 1
+    while f and len(f) - 1 >= dg:
+        c = f[-1] * ginv % P
+        shift = len(f) - 1 - dg
+        for i, b in enumerate(g):
+            f[shift + i] = (f[shift + i] - c * b) % P
+        ptrim(f)
+    return f
 
 
 def pgcd(f, g):
@@ -130,27 +145,34 @@ def pgcd(f, g):
     return f
 
 
-def pderiv(f):
-    return pstrip([i * f[i] % P for i in range(1, len(f))])
+def pdiv_exact(f, g):
+    f = list(f)
+    out = [0] * (len(f) - len(g) + 1)
+    ginv = pow(g[-1], P - 2, P)
+    while f and len(f) >= len(g):
+        c = f[-1] * ginv % P
+        shift = len(f) - len(g)
+        out[shift] = c
+        for i, b in enumerate(g):
+            f[shift + i] = (f[shift + i] - c * b) % P
+        ptrim(f)
+    assert not f, "division not exact"
+    return ptrim(out)
 
 
-def ppowmod(base, exp, mod):
+def pdiff(f):
+    return ptrim([(i * c) % P for i, c in enumerate(f)][1:])
+
+
+def ppowmod(base, e, mod):
     result = [1]
-    base = pmod(base, mod)
-    while exp:
-        if exp & 1:
+    base = pmod(list(base), mod)
+    while e:
+        if e & 1:
             result = pmod(pmul(result, base), mod)
         base = pmod(pmul(base, base), mod)
-        exp >>= 1
+        e >>= 1
     return result
-
-
-def pcompose_mod(f, g, mod):
-    """f(g(x)) mod `mod` by Horner."""
-    acc = []
-    for c in reversed(f):
-        acc = pmod(padd(pmul(acc, g), [c]), mod)
-    return acc
 
 
 def peval(f, x):
@@ -160,493 +182,348 @@ def peval(f, x):
     return acc
 
 
-# ------------------------------------------------- curve ring Fp[x,y]/(E)
-# Elements (f0, f1) = f0(x) + f1(x)*y with y^2 -> x^3 + a x + b.
+# ------------------------------------------------- division polynomial
 
 
-def ring_mul(u, v, c):
-    f0, f1 = u
-    g0, g1 = v
-    cross = pmul(pmul(f1, g1), c)
-    return (padd(pmul(f0, g0), cross), padd(pmul(f0, g1), pmul(f1, g0)))
+def division_poly_11(A, B):
+    """psi_11 as an x-polynomial, via the standard recurrences with
+    y^2 -> F = x^3 + Ax + B.  psi_n is stored as an x-poly carrying an
+    implicit factor y for even n (psi_2 = 2y is stored as [2])."""
+    F = [B % P, A % P, 0, 1]
 
-
-def division_polys(a, b, upto):
-    """psi_0..psi_upto in the curve ring for y^2 = x^3 + a x + b."""
-    c = [b % P, a % P, 0, 1]  # x^3 + a x + b
-    psi = [None] * (upto + 1)
-    psi[0] = ([], [])
-    psi[1] = ([1], [])
-    psi[2] = ([], [2])
-    psi[3] = (
-        pstrip([
-            (-(a * a)) % P,
-            12 * b % P,
-            6 * a % P,
-            0,
-            3,
-        ]),
-        [],
-    )
-    psi[4] = (
-        [],
-        pscale(
-            [
-                (-8 * b * b - a**3) % P,
-                (-4 * a * b) % P,
-                (-5 * a * a) % P,
-                20 * b % P,
-                5 * a % P,
-                0,
-                1,
-            ],
+    psi: dict[int, list[int]] = {
+        0: [],
+        1: [1],
+        2: [2],
+        3: ptrim([(-A * A) % P, (12 * B) % P, (6 * A) % P, 0, 3]),
+        4: pscale(
+            ptrim(
+                [
+                    (-8 * B * B - A * A * A) % P,
+                    (-4 * A * B) % P,
+                    (-5 * A * A) % P,
+                    (20 * B) % P,
+                    (5 * A) % P,
+                    0,
+                    1,
+                ]
+            ),
             4,
         ),
-    )
-    for m in range(5, upto + 1):
-        k = m // 2
-        if m & 1:  # psi_{2k+1} = psi_{k+2} psi_k^3 - psi_{k-1} psi_{k+1}^3
-            t1 = ring_mul(
-                psi[k + 2], ring_mul(psi[k], ring_mul(psi[k], psi[k], c), c), c
-            )
-            t2 = ring_mul(
-                psi[k - 1],
-                ring_mul(psi[k + 1], ring_mul(psi[k + 1], psi[k + 1], c), c),
-                c,
-            )
-            psi[m] = (psub(t1[0], t2[0]), psub(t1[1], t2[1]))
-        else:  # psi_{2k} = psi_k (psi_{k+2} psi_{k-1}^2 - psi_{k-2} psi_{k+1}^2)/2y
-            t1 = ring_mul(psi[k + 2], ring_mul(psi[k - 1], psi[k - 1], c), c)
-            t2 = ring_mul(psi[k - 2], ring_mul(psi[k + 1], psi[k + 1], c), c)
-            num = ring_mul(psi[k], (psub(t1[0], t2[0]), psub(t1[1], t2[1])), c)
-            g, g1 = num
-            assert not g1, "even psi numerator should be y-free"
-            half = pow(2, P - 2, P)
-            psi[m] = ([], pexactdiv(pscale(g, half), c))
-    return psi
+    }
 
+    def yexp(n):
+        return 1 if n % 2 == 0 else 0
 
-def psi11_poly(a, b):
-    """The 11-division polynomial as a plain x-polynomial (degree 60)."""
-    psi = division_polys(a, b, 13)
-    f, f1 = psi[11]
-    assert not f1
-    assert len(f) - 1 == 60, f"psi11 degree {len(f) - 1}"
-    return pscale(f, pow(f[-1], P - 2, P)), psi  # monic
+    def get(n):
+        if n in psi:
+            return psi[n]
+        m = n // 2
+        if n % 2 == 1:
+            # psi_{2m+1} = psi_{m+2} psi_m^3 − psi_{m−1} psi_{m+1}^3
+            a = pmul(get(m + 2), pmul(get(m), pmul(get(m), get(m))))
+            b = pmul(
+                get(m - 1), pmul(get(m + 1), pmul(get(m + 1), get(m + 1)))
+            )
+            ya = yexp(m + 2) + 3 * yexp(m)
+            yb = yexp(m - 1) + 3 * yexp(m + 1)
+            assert ya % 2 == 0 and yb % 2 == 0, (n, ya, yb)
+            for _ in range(ya // 2):
+                a = pmul(a, F)
+            for _ in range(yb // 2):
+                b = pmul(b, F)
+            out = psub(a, b)
+        else:
+            # psi_{2m} = psi_m (psi_{m+2} psi_{m−1}² − psi_{m−2} psi_{m+1}²)/(2y)
+            a = pmul(get(m + 2), pmul(get(m - 1), get(m - 1)))
+            b = pmul(get(m - 2), pmul(get(m + 1), get(m + 1)))
+            ya = yexp(m + 2) + 2 * yexp(m - 1)
+            yb = yexp(m - 2) + 2 * yexp(m + 1)
+            assert ya == yb, (n, ya, yb)
+            # y-power of psi_m·(A−B) is total; after /2y the stored poly
+            # keeps one implicit y (n even), so F-substitute the rest.
+            total = ya + yexp(m)
+            assert total >= 2 and total % 2 == 0, (n, total)
+            inner = psub(a, b)
+            for _ in range((total - 2) // 2):
+                inner = pmul(inner, F)
+            out = pscale(pmul(get(m), inner), pow(2, P - 2, P))
+        psi[n] = out
+        return out
+
+    f11 = get(11)
+    assert len(f11) - 1 == 60, f"psi_11 degree {len(f11) - 1}, want 60"
+    assert f11[-1] % P == 11, "psi_11 leading coefficient must be 11"
+    return f11
 
 
 # ------------------------------------------------- kernel extraction
 
 
-def kernel_polys(a, b, cache_key, cache):
-    """ALL monic degree-5 kernel polynomials of the Fp-rational order-11
-    subgroups of y^2 = x^3 + a x + b.  For BLS12-381's E, Frobenius is a
-    scalar mod 11, so every one of the 12 subgroups is rational and psi11
-    splits into 12 quintic kernel polynomials."""
-    if cache_key in cache:
-        return [[int(v, 16) for v in k] for k in cache[cache_key]]
-    f, _psi = psi11_poly(a, b)
-    print(f"[{cache_key}] psi11 ready (deg {len(f) - 1}); computing x^p ...")
-    xp_key = cache_key + "_xp"
-    if xp_key in cache:
-        xp = [int(v, 16) for v in cache[xp_key]]
-    else:
-        xp = ppowmod([0, 1], P, f)  # the slow step
-        cache[xp_key] = [hex(v) for v in xp]
-        save_cache(cache)
-    print(f"[{cache_key}] x^p done; verifying x^(p^5) = x ...")
-    xpk = xp
-    for _ in range(4):
-        xpk = pcompose_mod(xpk, xp, f)
-    h = pgcd(psub(xpk, [0, 1]), f)
-    assert len(h) - 1 == 60, (
-        f"expected all psi11 roots in F_p^5, gcd degree {len(h) - 1}"
-    )
-    h1 = pgcd(psub(xp, [0, 1]), f)
-    assert len(h1) <= 1, "unexpected rational 11-torsion x-coords"
+def rational_kernels(A, B):
+    """Degree-5 kernel polynomials of the rational 11-isogenies from
+    y^2 = x^3 + Ax + B (the x-coordinates of each order-11 subgroup)."""
+    psi11 = division_poly_11(A, B)
+    psi11 = pscale(psi11, pow(psi11[-1], P - 2, P))  # monic
+    xp = ppowmod([0, 1], P, psi11)
+    lin = pgcd(psub(xp, [0, 1]), psi11)
+    d = len(lin) - 1
+    if d == 0:
+        raise AssertionError(
+            "no rational 11-torsion x-coordinates; parameter transcription wrong?"
+        )
+    if d == 5:
+        return [lin]
+    if d == 10:
+        # two rational subgroups: equal-degree split (Cantor–Zassenhaus)
+        import random as _random
 
-    # Equal-degree factorization into irreducible quintics via the trace
-    # map: T(r) = sum_k r^(p^k) is a constant c_i mod each quintic factor;
-    # gcd(T^((p-1)/2) - 1, g) splits factors by the QR-ness of c_i.
-    import random as _random
-
-    rnd = _random.Random(0xCE55)
-
-    def frob_powers(g):
-        xg = pmod(xp, g)
-        pows = [[0, 1], xg]
-        for _ in range(3):
-            pows.append(pcompose_mod(pows[-1], xg, g))
-        return pows
-
-    def split(g):
-        if len(g) - 1 == 5:
-            return [g]
-        pows = frob_powers(g)
-        while True:
-            r = [rnd.randrange(P) for _ in range(len(g) - 1)]
-            t = []
-            for pw in pows:
-                t = padd(t, pcompose_mod(r, pw, g))
-            s = ppowmod(t, (P - 1) // 2, g)
-            d = pgcd(psub(s, [1]), g)
-            if 0 < len(d) - 1 < len(g) - 1:
-                rest = pexactdiv(g, d)
-                rest = pscale(rest, pow(rest[-1], P - 2, P))
-                print(
-                    f"[{cache_key}] split {len(g)-1} -> "
-                    f"{len(d)-1} + {len(rest)-1}"
-                )
-                return split(d) + split(rest)
-
-    kernels = split(f)
-    assert len(kernels) == 12 and all(len(k) - 1 == 5 for k in kernels)
-    cache[cache_key] = [[hex(v) for v in k] for k in kernels]
-    save_cache(cache)
-    return kernels
+        rng = _random.Random(0xCE55)
+        for _ in range(64):
+            delta = rng.randrange(P)
+            probe = ppowmod([delta, 1], (P - 1) // 2, lin)
+            g = pgcd(psub(probe, [1]), lin)
+            if 0 < len(g) - 1 < 10:
+                h1 = pgcd(g, lin) if len(g) - 1 == 5 else None
+                if h1 is None:
+                    # uneven split: refine by gcd with the cofactor
+                    part = g
+                    other = pdiv_exact(lin, part)
+                    cands = [part, other]
+                    fives = [c for c in cands if len(c) - 1 == 5]
+                    if len(fives) == 2:
+                        return fives
+                    continue
+                h2 = pdiv_exact(lin, h1)
+                if len(h2) - 1 == 5:
+                    return [h1, h2]
+        raise AssertionError("equal-degree split did not converge")
+    raise AssertionError(f"unexpected rational x-coordinate count {d}")
 
 
-def dual_kernel_poly(ker, other, maps):
-    """Kernel polynomial of the dual isogeny, computed in F_{p^5}.
-
-    ker phi-hat = phi(E[11]); the x-coords of the image of any OTHER
-    order-11 subgroup generate it.  Work in F_{p^5} = Fp[x]/other(x): the
-    image x-coordinate phi_x(alpha) and its five Frobenius conjugates give
-    the minimal polynomial directly."""
-    Nx, Dx, _Ny, _Dy = maps
-    k = other  # irreducible quintic
-
-    def fmul(u, v):
-        return pmod(pmul(u, v), k)
-
-    def finv(u):
-        # extended Euclid in Fp[x]/k
-        r0, r1 = list(k), pmod(u, k)
-        s0, s1 = [], [1]
-        while r1:
-            q, r2 = pdivmod(r0, r1)
-            r0, r1 = r1, r2
-            s0, s1 = s1, psub(s0, pmul(q, s1))
-        c = pow(r0[0], P - 2, P)  # r0 is a nonzero constant
-        return pscale(pmod(s0, k), c)
-
-    def feval_poly(f):
-        # evaluate f (coeffs in Fp) at alpha: just reduce f mod k
-        return pmod(f, k)
-
-    alpha_img = fmul(feval_poly(Nx), finv(feval_poly(Dx)))
-    xp_k = ppowmod([0, 1], P, k)
-    conjs = [alpha_img]
-    for _ in range(4):
-        conjs.append(pcompose_mod(conjs[-1], xp_k, k))
-    # minpoly(X) = prod (X - conj_j), coefficients in F_{p^5}; they must
-    # collapse to Fp constants.
-    coeffs = [[1]]
-    for c in conjs:
-        # multiply (X - c) into coeffs
-        new = [[] for _ in range(len(coeffs) + 1)]
-        for i, co in enumerate(coeffs):
-            new[i + 1] = padd(new[i + 1], co)
-            new[i] = psub(new[i], fmul(co, c))
-        coeffs = new
-    out = []
-    for co in coeffs:
-        assert len(co) <= 1, "dual kernel coefficient not in Fp"
-        out.append(co[0] if co else 0)
-    assert len(out) == 6 and out[5] == 1
-    return out
+# ------------------------------------------------- Velu
 
 
-# ------------------------------------------------- Velu / Kohel
-
-
-def velu_from_kernel(a, b, h):
-    """11-isogeny with kernel polynomial h (monic, degree 5) from
-    y^2 = x^3 + a x + b.  Returns (a2, b2, Nx, Dx, Ny, Dy) where
-    phi(x, y) = (Nx(x)/Dx(x), y * Ny(x)/Dy(x)).
-
-    Velu sums over kernel roots are evaluated without leaving Fp via
-      sum_i Q(x_i)/(x - x_i) = (Q * h' mod h)(x) / h(x)      (deg Q < 5)
-    and power sums from Newton's identities.
+def velu(A, B, h):
+    """Velu's formulas with kernel polynomial h (degree 5, monic):
+    returns (A2, B2, x_num, x_den, y_num, y_den) where
+      phi_x = x_num/x_den,  phi_y = y · y_num/y_den  (normalized).
     """
-    d = len(h) - 1
-    assert d == 5
-    # Newton power sums p1..p3 from monic coefficients.
-    e1 = (-h[d - 1]) % P
-    e2 = h[d - 2] % P
-    e3 = (-h[d - 3]) % P
+    hp = pdiff(h)
+
+    def trace(tau):
+        # sum_i tau(x_i)·h(x)/(x−x_i) = (tau·h') mod h  (degree < 5
+        # interpolation of tau(x_i)·h'(x_i) at the kernel roots)
+        return pmod(pmul(tau, hp), h)
+
+    # per x-coordinate (each ±pair of kernel points counted once):
+    #   t_i = 2(3 x_i² + A),  u_i = 4(x_i³ + A x_i + B)
+    tau_t = pscale([A % P, 0, 3], 2)
+    tau_u = pscale([B % P, A % P, 0, 1], 4)
+
+    # power sums of the kernel x-coordinates from h's coefficients
+    e1 = (-h[4]) % P
+    e2 = h[3] % P
+    e3 = (-h[2]) % P
     p1 = e1
     p2 = (e1 * p1 - 2 * e2) % P
     p3 = (e1 * p2 - e2 * p1 + 3 * e3) % P
+    sum_t = (6 * p2 + 10 * A) % P
+    sum_w = (10 * p3 + 6 * A * p1 + 20 * B) % P
+    A2 = (A - 5 * sum_t) % P
+    B2 = (B - 7 * sum_w) % P
 
-    # t_i = 6 x_i^2 + 2a ; u_i = 4(x_i^3 + a x_i + b)
-    # W = sum(u_i + x_i t_i) = sum(10 x^3 + 6a x + 4b)
-    T = (6 * p2 + 2 * a * d) % P
-    W = (10 * p3 + 6 * a * p1 + 4 * b * d) % P
-    a2 = (a - 5 * T) % P
-    b2 = (b - 7 * W) % P
-
-    hp = pderiv(h)
-    # T1(x) = (t(x) * h'(x)) mod h ;  U1(x) = (u(x) * h'(x)) mod h
-    tpoly = pstrip([2 * a % P, 0, 6])
-    upoly = pstrip([4 * b % P, 4 * a % P, 0, 4])
-    T1 = pmod(pmul(tpoly, hp), h)
-    U1 = pmod(pmul(upoly, hp), h)
-    # phi_x = x + T1/h + (U1 h' - U1' h)/h^2  =  Nx / h^2
+    # phi_x = x + T/h + (U h' − U' h)/h² = N/h²
+    T = trace(tau_t)
+    U = trace(tau_u)
     h2 = pmul(h, h)
-    Nx = padd(
+    N = padd(
         pmul([0, 1], h2),
-        padd(pmul(T1, h), psub(pmul(U1, hp), pmul(pderiv(U1), h))),
+        padd(pmul(T, h), psub(pmul(U, hp), pmul(pdiff(U), h))),
     )
-    Dx = h2
-    # phi_y = y * d/dx(phi_x) = y * (Nx' h - 2 Nx h') / h^3
-    Ny = psub(pmul(pderiv(Nx), h), pscale(pmul(Nx, hp), 2))
-    Dy = pmul(h2, h)
-    assert len(Nx) - 1 == 11 and len(Dx) - 1 == 10
-    return a2, b2, Nx, Dx, Ny, Dy
 
-
-def on_curve(a, b, x, y):
-    return (y * y - (x * x % P * x + a * x + b)) % P == 0
-
-
-def random_point(a, b, seed=5):
-    x = seed
-    while True:
-        rhs = (x * x % P * x + a * x + b) % P
-        y = pow(rhs, (P + 1) // 4, P)
-        if y * y % P == rhs:
-            return x, y
-        x += 1
-
-
-def apply_map(maps, x, y):
-    Nx, Dx, Ny, Dy = maps
-    den = peval(Dx, x)
-    if den == 0:
-        return None  # kernel point -> infinity
-    X = peval(Nx, x) * pow(den, P - 2, P) % P
-    Y = y * peval(Ny, x) % P * pow(peval(Dy, x), P - 2, P) % P
-    return X, Y
+    # phi_y = y·d(phi_x)/dx = y·(N' h − 2 N h')/h³
+    y_num = psub(pmul(pdiff(N), h), pscale(pmul(N, hp), 2))
+    y_den = pmul(h2, h)
+    return A2, B2, N, h2, y_num, y_den
 
 
 # ------------------------------------------------- roots in Fp
 
 
-def sqrt_fp(v):
-    r = pow(v, (P + 1) // 4, P)
-    return r if r * r % P == v % P else None
+def sqrt_fp(a):
+    a %= P
+    r = pow(a, (P + 1) // 4, P)
+    return r if r * r % P == a else None
 
 
-def nth_roots(v, n):
-    """All n-th roots of v in Fp for small n (via factorization of the
-    multiplicative order structure; implemented for n | 6)."""
-    v %= P
-    assert n in (2, 3, 6)
-    if n == 2:
-        r = sqrt_fp(v)
-        return [] if r is None else sorted({r, P - r})
-    if n == 3:
-        if pow(v, (P - 1) // 3, P) != 1:
-            return []
-        from sympy.ntheory.residue_ntheory import nthroot_mod
-
-        roots = nthroot_mod(v, 3, P, all_roots=True)
-        assert roots and all(pow(r, 3, P) == v for r in roots)
-        return sorted(int(r) for r in roots)
-    roots = []
-    for c in nth_roots(v, 3):
-        roots.extend(nth_roots(c, 2))
-    return sorted(set(roots))
-
-
-# ------------------------------------------------- cache
-
-
-def load_cache():
-    if os.path.exists(CACHE):
-        with open(CACHE) as fh:
-            return json.load(fh)
-    return {}
-
-
-def save_cache(cache):
-    with open(CACHE, "w") as fh:
-        json.dump(cache, fh)
-
-
-# ------------------------------------------------- KAT
+def cbrt_fp(a):
+    """Cube root via discrete log in the 3-Sylow subgroup of Fp*."""
+    a %= P
+    if a == 0:
+        return 0
+    if pow(a, (P - 1) // 3, P) != 1:
+        return None
+    s, t = 0, P - 1
+    while t % 3 == 0:
+        s, t = s + 1, t // 3
+    g = 2
+    while pow(g, (P - 1) // 3, P) == 1:
+        g += 1
+    e = pow(g, t, P)  # generates the 3-Sylow subgroup, order 3^s
+    order = 3**s
+    # k with e^k = a^t  (base-3 digits, s is tiny)
+    target = pow(a, t, P)
+    k = 0
+    for j in range(s):
+        probe = target * pow(e, (order - k) % order, P) % P
+        if pow(probe, 3 ** (s - 1 - j), P) != 1:
+            for m in (1, 2):
+                trial = (k + m * 3**j) % order
+                probe2 = target * pow(e, (order - trial) % order, P) % P
+                if pow(probe2, 3 ** (s - 1 - j), P) == 1:
+                    k = trial
+                    break
+            else:
+                return None
+    if k % 3 != 0:
+        return None
+    c = a * pow(e, (order - k) % order, P) % P  # order divides t, 3 ∤ t
+    r = pow(c, pow(3, -1, t), P) * pow(e, k // 3, P) % P
+    return r if pow(r, 3, P) == a else None
 
 
-def kat_ok(map_fn):
-    """True iff hash-with-candidate-map reproduces the reference IC vector
-    (reference: utils/verify-bls-signatures/tests/tests.rs:121-127)."""
-    sk = int("6f3977f6051e184b2c412daa1b5c0115ef7ab347cac8d808ffa2c26bd0658243", 16)
-    msg = bytes.fromhex(
-        "50484522ad8aede64ec7f86b9273b7ed3940481acf93cdd40a2b77f2be2734a1"
-        "4012b2492b6363b12adaeaf055c573e4611b085d2e0fe2153d72453a95eaebf3"
-        "50ac3ba6a26ba0bc79f4c0bf5664dfdf5865f69f7fc6b58ba7d068e8"
-    )
-    expected = "8f7ad830632657f7b3eae17fd4c3d9ff5c13365eea8d33fd0a1a6d8fbebc5152e066bb0ad61ab64e8a8541c8e3f96de9"
-    u0, u1 = bls.hash_to_field_fp(msg, bls.DST_G1, 2)
-    q0 = map_fn(u0)
-    q1 = map_fn(u1)
-    if q0 is None or q1 is None:
-        return False
-    h = bls.clear_cofactor_g1(q0 + q1)
-    sig = h.mul(sk).to_bytes().hex()
-    return sig == expected
+def sixth_roots(a):
+    """All w in Fp with w^6 = a."""
+    a %= P
+    out = set()
+    s = sqrt_fp(a)
+    if s is None:
+        return []
+    omega = None
+    g = 2
+    while True:
+        omega = pow(g, (P - 1) // 3, P)
+        if omega != 1:
+            break
+        g += 1
+    for sr in (s, P - s):
+        c = cbrt_fp(sr)
+        if c is None:
+            continue
+        for w in (c, c * omega % P, c * omega % P * omega % P):
+            if pow(w, 6, P) == a:
+                out.add(w)
+    return sorted(out)
 
 
-def sswu_raw(u, A, B, Z):
-    """RFC 9380 §6.6.2 simplified SWU for AB != 0 curves; returns a point
-    on y^2 = x^3 + A x + B."""
+# ------------------------------------------------- SSWU + selection
+
+
+def sswu_xy(u, A, B, Z):
+    """RFC 9380 §6.6.2 simplified SWU onto y² = x³ + Ax + B (A·B ≠ 0)."""
     u %= P
-    tv1 = (Z * Z % P * pow(u, 4, P) + Z * u * u) % P
-    if tv1 == 0:
-        x1 = B * pow((Z * A) % P, P - 2, P) % P
+    tv = Z * u % P * u % P
+    tv2 = (tv * tv + tv) % P
+    if tv2 == 0:
+        x1 = B * pow(Z * A % P, P - 2, P) % P
     else:
-        x1 = (-B) % P * pow(A, P - 2, P) % P * (1 + pow(tv1, P - 2, P)) % P
-    gx1 = (pow(x1, 3, P) + A * x1 + B) % P
+        x1 = (-B) % P * pow(A, P - 2, P) % P * (1 + pow(tv2, P - 2, P)) % P
+    gx1 = (x1 * x1 % P * x1 + A * x1 + B) % P
     y1 = sqrt_fp(gx1)
     if y1 is not None:
         x, y = x1, y1
     else:
-        x2 = Z * u * u % P * x1 % P
-        gx2 = (pow(x2, 3, P) + A * x2 + B) % P
-        y2 = sqrt_fp(gx2)
-        assert y2 is not None, "SSWU: neither branch square (impossible)"
-        x, y = x2, y2
-    if (u % 2) != (y % 2):  # sgn0 alignment
+        x = tv * x1 % P
+        gx2 = (x * x % P * x + A * x + B) % P
+        y = sqrt_fp(gx2)
+        assert y is not None, "SSWU: neither candidate is square"
+    if (y & 1) != (u & 1):  # sgn0 alignment
         y = P - y
     return x, y
 
 
-# ------------------------------------------------- main derivation
+def make_apply(xn, xd, yn, yd):
+    def apply(x, y):
+        den = peval(xd, x)
+        if den == 0:
+            return None  # kernel x-coordinate → maps to infinity
+        X = peval(xn, x) * pow(den, P - 2, P) % P
+        Y = y * peval(yn, x) % P * pow(peval(yd, x), P - 2, P) % P
+        return X, Y
+
+    return apply
+
+
+def hash_to_g1_with(apply_iso, msg, dst):
+    us = bls.hash_to_field_fp(msg, dst, 2)
+    pts = []
+    for u in us:
+        x, y = sswu_xy(u, A_PRIME, B_PRIME, Z_SSWU)
+        out = apply_iso(x, y)
+        assert out is not None, "hash input hit the isogeny kernel"
+        pts.append(bls.G1Point(out[0], out[1]))
+    return (pts[0] + pts[1])._mul_raw(H_EFF)
 
 
 def main():
-    cache = load_cache()
-
-    print("== stage 1: the 12 kernels of E ==")
-    kernels_E = kernel_polys(A_E, B_E, "kernels_E", cache)
+    print("deriving rational 11-isogeny kernels of E' ...", flush=True)
+    kernels = rational_kernels(A_PRIME, B_PRIME)
+    print(f"  {len(kernels)} rational kernel(s)")
 
     candidates = []
-    for ki, hker in enumerate(kernels_E):
-        a2, b2, *maps_E_E2 = velu_from_kernel(A_E, B_E, hker)
-        # sanity: isogeny maps E points onto E2
-        x0, y0 = random_point(A_E, B_E)
-        img = apply_map(maps_E_E2, x0, y0)
-        assert img and on_curve(a2, b2, *img), "Velu map sanity failed"
-
-        # Does E' (A', B'?) live over this codomain? need u^4 = a2/A'.
-        ratio = a2 * pow(A_PRIME, P - 2, P) % P
-        u2s = [u2 for u2 in nth_roots(ratio, 2) if nth_roots(u2, 2)]
-        if not u2s:
+    for ki, h in enumerate(kernels):
+        A2, B2, x_num, x_den, y_num, y_den = velu(A_PRIME, B_PRIME, h)
+        if A2 != 0:
+            print(f"  kernel {ki}: codomain A2 != 0 (j != 0), skipped")
             continue
-        print(f"kernel {ki}: codomain admits E' model (u2 count {len(u2s)})")
+        for w in sixth_roots(B2 * pow(4, P - 2, P) % P):
+            # fold the E2→E scaling (x/w², y/w³) into the maps
+            xn = pscale(x_num, pow(pow(w, 2, P), P - 2, P))
+            yn = pscale(y_num, pow(pow(w, 3, P), P - 2, P))
+            candidates.append((ki, w, xn, x_den, yn, y_den))
+    print(f"  {len(candidates)} candidate normalizations")
 
-        # dual isogeny kernel on E2: image of any other subgroup.
-        other = kernels_E[(ki + 1) % len(kernels_E)]
-        hdual = dual_kernel_poly(hker, other, maps_E_E2)
-        a3, b3, *maps_E2_E3 = velu_from_kernel(a2, b2, hdual)
-        assert a3 == 0, f"dual codomain not j=0 (a3={hex(a3)[:16]}..)"
-        x1, y1 = random_point(a2, b2)
-        img2 = apply_map(maps_E2_E3, x1, y1)
-        assert img2 and on_curve(a3, b3, *img2)
+    selected = None
+    for ki, w, xn, xd, yn, yd in candidates:
+        hpt = hash_to_g1_with(make_apply(xn, xd, yn, yd), KAT_MSG, IC_DST)
+        if hpt.mul(KAT_SK).to_bytes() == KAT_SIG:
+            selected = (ki, w, xn, xd, yn, yd)
+            break
+    assert selected is not None, "no normalization reproduces the IC KAT"
+    ki, w, xn, xd, yn, yd = selected
+    print(f"  selected kernel {ki}, scale w = {hex(w)[:20]}…")
 
-        for u2 in u2s:
-            u = nth_roots(u2, 2)[0]
-            B_candidate = b2 * pow(pow(u2, 3, P), P - 2, P) % P
-            for v in nth_roots(4 * pow(b3, P - 2, P) % P, 6):
-                candidates.append(
-                    (ki, u, u2, B_candidate, maps_E_E2, a2, b2,
-                     maps_E2_E3, b3, v)
-                )
-
-    print(f"== stage 2: {len(candidates)} composite candidates; KAT-testing ==")
-    from cess_tpu.ops.bls12_381 import G1Point
-
-    for cand in candidates:
-        (ki, u, u2, Bc, mE, a2, b2, mD, b3, v) = cand
-
-        def compose(ufield, _c=cand):
-            (ki, u, u2, Bc, mE, a2, b2, mD, b3, v) = _c
-            x, y = sswu_raw(ufield, A_PRIME, Bc, Z_SSWU)
-            # sigma: E' -> E2
-            x, y = u2 * x % P, u2 * u % P * y % P
-            assert on_curve(a2, b2, x, y)
-            # dual isogeny E2 -> E3
-            res = apply_map(mD, x, y)
-            if res is None:
-                return None
-            x, y = res
-            x, y = v * v % P * x % P, pow(v, 3, P) * y % P
-            if not on_curve(A_E, B_E, x, y):
-                return None
-            return G1Point(x, y)
-
-        if kat_ok(compose):
-            print(f"KAT PASS: kernel {ki} u2={hex(u2)[:18]}.. v={hex(v)[:18]}..")
-            emit(cand)
-            return
-    print("NO candidate passed the KAT — check A' or assumptions.")
-    sys.exit(1)
-
-
-def emit(cand):
-    """Flatten the winning composite into x_num/x_den/y_num/y_den
-    coefficient lists (the RFC iso_map shape) and write the generated
-    module."""
-    (ki, u, u2, Bc, mE, a2, b2, mD, b3, v) = cand
-    Nx, Dx, Ny, Dy = mD
-
-    # pre-scale: x -> u2 * x on inputs of the dual maps
-    def prescale(f, s):
-        return [c * pow(s, i, P) % P for i, c in enumerate(f)]
-
-    Nxs = prescale(Nx, u2)
-    Dxs = prescale(Dx, u2)
-    Nys = prescale(Ny, u2)
-    Dys = prescale(Dy, u2)
-    # post-scale x by v^2, y by v^3 * (u2 * u) [the sigma y factor]
-    xnum = pscale(Nxs, v * v % P)
-    xden = Dxs
-    ynum = pscale(Nys, pow(v, 3, P) * (u2 * u % P) % P)
-    yden = Dys
-    # normalize: make x_den monic (divide num&den pairs by leading coeff)
-    c = pow(xden[-1], P - 2, P)
-    xnum, xden = pscale(xnum, c), pscale(xden, c)
-    c = pow(yden[-1], P - 2, P)
-    ynum, yden = pscale(ynum, c), pscale(yden, c)
-
-    out = os.path.join(
+    out_path = os.path.join(
         os.path.dirname(__file__), "..", "cess_tpu", "ops", "_sswu_g1.py"
     )
-    with open(out, "w") as fh:
-        fh.write(
-            '"""GENERATED by tools/derive_sswu.py — do not edit.\n\n'
-            "RFC 9380 G1 simplified-SWU auxiliary curve and 11-isogeny for\n"
-            "BLS12-381, derived via division polynomials + Velu's formulas\n"
-            "and pinned by the IC signature KAT carried by the reference\n"
-            "(utils/verify-bls-signatures/tests/tests.rs).  The values\n"
-            "coincide with RFC 9380 Appendix E.2 by construction.\n"
+
+    def fmt(coeffs):
+        rows = ",\n    ".join(hex(c) for c in coeffs)
+        return f"[\n    {rows},\n]"
+
+    with open(out_path, "w") as f:
+        f.write(
+            '"""RFC 9380 SSWU parameters + 11-isogeny for BLS12-381 G1.\n'
+            "\n"
+            "GENERATED by tools/derive_sswu.py - the isogeny coefficients are\n"
+            "DERIVED (division polynomial -> rational kernel -> Velu -> codomain\n"
+            "scaling), not transcribed; the normalization is pinned by the IC\n"
+            "known-answer vectors mirrored from the reference\n"
+            "(utils/verify-bls-signatures/tests/tests.rs:96-127).  Maps are dense\n"
+            "little-endian coefficient lists over Fp:\n"
+            "  x' = X_NUM(x)/X_DEN(x)\n"
+            "  y' = y * Y_NUM(x)/Y_DEN(x)\n"
             '"""\n\n'
+            f"A_PRIME = {hex(A_PRIME)}\n\n"
+            f"B_PRIME = {hex(B_PRIME)}\n\n"
+            f"Z_SSWU = {Z_SSWU}\n\n"
+            f"X_NUM = {fmt(xn)}\n\n"
+            f"X_DEN = {fmt(xd)}\n\n"
+            f"Y_NUM = {fmt(yn)}\n\n"
+            f"Y_DEN = {fmt(yd)}\n"
         )
-        fh.write(f"SSWU_A = {hex(A_PRIME)}\n")
-        fh.write(f"SSWU_B = {hex(Bc)}\n")
-        fh.write(f"SSWU_Z = {Z_SSWU}\n\n")
-        for name, coeffs in (
-            ("ISO_X_NUM", xnum),
-            ("ISO_X_DEN", xden),
-            ("ISO_Y_NUM", ynum),
-            ("ISO_Y_DEN", yden),
-        ):
-            fh.write(f"{name} = [\n")
-            for cco in coeffs:
-                fh.write(f"    {hex(cco)},\n")
-            fh.write("]\n\n")
-    print(f"wrote {os.path.normpath(out)}")
+    print(f"wrote {os.path.normpath(out_path)}")
 
 
 if __name__ == "__main__":
