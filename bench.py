@@ -1,98 +1,170 @@
-"""North-star benchmark: PoDR2 audit data plane + RS recovery on TPU.
+"""North-star benchmark: MEASURED PoDR2 verification + RS recovery on TPU.
 
-Measures the device data plane of the BASELINE.json north star — "verify
-100k PoDR2 proofs + RS-reconstruct 10 GiB on a v5e-1 in < 60 s" — and
-reports the projected wall-clock for that workload as ONE JSON line:
+BASELINE.json north star: "verify 100k PoDR2 proofs + RS-reconstruct
+10 GiB on a v5e-1 in < 60 s".  This bench MEASURES (no projections):
 
-  {"metric": "north_star_dataplane_s", "value": <projected seconds>,
-   "unit": "s", "vs_baseline": <60 / value>}
+ 1. `verify_batch` end-to-end through the xla ProofBackend at the FULL
+    protocol geometry (1024-chunk × 265-sector fragments, 47 challenged
+    chunks, distinct fragment names) for a batch of BENCH_PROOFS proofs:
+    every G1 MSM on device (ops/g1.py), hash-to-curve per challenged
+    chunk (host SSWU — the random-oracle work the verifier cannot skip;
+    the chunk-point cache is cleared first), the μ/ρ limb combine on
+    device (ops/fr.py), and the two pairings.  The proofs are valid
+    (crafted with the TEE secret key over zero-data fragments, which
+    leaves every verifier-side cost intact), so the all-honest path —
+    ONE combined check — is what's timed.
+ 2. RS(2,1) reconstruction compute for 10 GiB of segment data at 16 MiB
+    segment geometry, processed as repeated passes over a device-resident
+    512 MiB working set (the tunnelled host↔device link of this rig is
+    not the deployment data path; the kernel work is real and complete).
 
-Components timed on the real chip:
- * RS(2,1) segment reconstruction (ops/rs.py bitplane MXU path) at 16 MiB
-   segment geometry → GiB/s → seconds for 10 GiB;
- * PoDR2 μ aggregation (ops/fr.py limb matmul) at protocol challenge
-   density (47 chunks × 265 sectors) → proofs/s → seconds for 100k proofs.
+Output is ONE JSON line:
+  {"metric": "podr2_verify<B>@1024x265+rs10gib_measured_s",
+   "value": <measured seconds for both parts>, "unit": "s",
+   "vs_baseline": 60 / (rs_s + per_proof_s * 100_000)}
 
-vs_baseline > 1 means the projected data plane beats the 60 s target.
-(G1/pairing work still runs host-side this round — see
-cess_tpu/proof/xla_backend.py — so this measures the device data plane,
-not yet the full verification pipeline.)
+so `value` is a pure measurement and `vs_baseline` scores the measured
+per-proof cost against the 100k-proof target.  Components go to stderr.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
 
-import numpy as np
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
 
 
-def _bench_rs(device_count_bytes: int = 1 << 28) -> float:
-    """Returns GiB/s for RS segment reconstruction on device."""
+# ---------------------------------------------------------------- RS part
+
+
+def bench_rs_10gib() -> float:
+    """Measured seconds of device reconstruction compute for 10 GiB."""
     import jax
+    import jax.numpy as jnp
+    import numpy as np
 
     from cess_tpu.ops.rs import segment_code
 
-    import jax.numpy as jnp
-
     code = segment_code()
     frag = 8 * (1 << 20)
-    batch = max(1, device_count_bytes // (2 * frag))
+    seg = 2 * frag
+    resident = 32  # segments resident on device (512 MiB of data shards)
+    total_segments = (10 * (1 << 30)) // seg  # 640
+    passes = -(-total_segments // resident)
+
     rng = np.random.default_rng(1)
-    shards_host = rng.integers(0, 256, size=(batch, 2, frag), dtype=np.uint8)
-    # Stage on device once: this measures the chip's reconstruct kernel (the
-    # environment's tunnelled host↔device link is not the deployment path).
+    shards_host = rng.integers(0, 256, size=(resident, 2, frag), dtype=np.uint8)
     shards = jax.device_put(jnp.asarray(shards_host))
     jax.block_until_ready(shards)
-    # Reconstruct data shards from (data1, parity) — the recovery direction.
-    present = [1, 2]
-    out = code.reconstruct_batch(shards, present)  # compile
-    jax.block_until_ready(out)
+    present = [1, 2]  # recover from (data1, parity)
+    jax.block_until_ready(code.reconstruct_batch(shards, present))  # compile
+
     t0 = time.perf_counter()
-    reps = 3
-    for _ in range(reps):
+    done = 0
+    out = None
+    while done < total_segments:
         out = code.reconstruct_batch(shards, present)
-        jax.block_until_ready(out)
-    dt = (time.perf_counter() - t0) / reps
-    bytes_recovered = batch * 2 * frag
-    return bytes_recovered / dt / (1 << 30)
-
-
-def _bench_mu(n_proofs: int = 256) -> float:
-    """Returns proofs/s for μ aggregation at protocol geometry."""
-    import jax
-    import jax.numpy as jnp
-
-    from cess_tpu.ops import fr
-
-    C, S, LM = 47, 265, 36
-    rng = np.random.default_rng(2)
-    w = jnp.asarray(rng.integers(0, 128, size=(C, 23), dtype=np.int8))
-    v = jnp.asarray(
-        rng.integers(0, 128, size=(n_proofs, S, C, LM), dtype=np.int8)
-    )
-    out = fr.weighted_sum_jit(w, v)  # compile
+        done += resident
     jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    log(f"rs: {passes} passes x {resident} segments, {dt:.2f}s "
+        f"({10.0 / dt:.2f} GiB/s)")
+    return dt
+
+
+# ---------------------------------------------------------------- verify
+
+
+def bench_verify(n_proofs: int) -> tuple[float, float]:
+    """Returns (measured seconds for the batch, per-proof marginal s).
+
+    The marginal is measured, not assumed: the batch is timed at B and at
+    B//2, and the slope ((t_B - t_half) / (B - B/2)) isolates the
+    per-proof cost from the batch-constant work (u-side fold, pairings)."""
+    import random
+
+    from cess_tpu.ops import podr2
+    from cess_tpu.ops.podr2 import Challenge, Podr2Params
+    from cess_tpu.proof import XlaBackend
+
+    params = Podr2Params()  # protocol geometry: n=1024, s=265
+    sk, pk = podr2.keygen(b"bench-tee")
+    rnd = random.Random(0xBE7C)
+    indices = tuple(sorted(rnd.sample(range(params.n), 47)))
+    randoms = tuple(rnd.randbytes(20) for _ in indices)
+    challenge = Challenge(indices=indices, randoms=randoms)
+    coeffs = challenge.coefficients()
+
+    def craft(names: list[bytes]) -> list:
+        """Valid zero-data proofs: σ = (Π_c H(name,i_c)^{v_c})^sk, μ = 0.
+        Verifier-side work is identical to arbitrary-data proofs."""
+        from cess_tpu.ops import g1
+
+        flat = podr2.chunk_points_batch(
+            [(nm, i) for nm in names for i in indices]
+        )
+        h_pts = [
+            flat[k * len(indices) : (k + 1) * len(indices)]
+            for k in range(len(names))
+        ]
+        inner = g1.msm_grouped(h_pts, [coeffs] * len(names), bits=160)
+        sigmas = g1.scalar_mul_batch(inner, [sk] * len(names))
+        mu = [0] * params.s
+        return [
+            (nm, challenge, podr2.Podr2Proof(s.to_bytes(), list(mu)))
+            for nm, s in zip(names, sigmas)
+        ]
+
+    names = [b"bench-frag-%08d" % i for i in range(n_proofs)]
     t0 = time.perf_counter()
-    reps = 3
-    for _ in range(reps):
-        out = fr.weighted_sum_jit(w, v)
-        jax.block_until_ready(out)
-    dt = (time.perf_counter() - t0) / reps
-    return n_proofs / dt
+    items = craft(names)
+    log(f"proofgen: {n_proofs} proofs in {time.perf_counter() - t0:.2f}s")
+
+    backend = XlaBackend()
+
+    def timed_verify(sub_items) -> float:
+        podr2.chunk_point.cache_clear()  # verifier re-derives H honestly
+        t0 = time.perf_counter()
+        verdicts = backend.verify_batch(pk, sub_items, b"bench-seed", params)
+        dt = time.perf_counter() - t0
+        assert all(verdicts), "bench proofs must verify"
+        return dt
+
+    # warm the kernels at both sizes (compile time excluded)
+    timed_verify(items[: n_proofs // 2])
+    timed_verify(items)
+
+    t_half = timed_verify(items[: n_proofs // 2])
+    t_full = timed_verify(items)
+    per_proof = (t_full - t_half) / (n_proofs - n_proofs // 2)
+    log(f"verify: B={n_proofs} in {t_full:.2f}s; B={n_proofs // 2} in "
+        f"{t_half:.2f}s; marginal {per_proof * 1000:.1f} ms/proof")
+    return t_full, per_proof
+
+
+# ---------------------------------------------------------------- main
 
 
 def main() -> None:
-    rs_gib_s = _bench_rs()
-    proofs_s = _bench_mu()
-    projected = 10.0 / rs_gib_s + 100_000.0 / proofs_s
+    n_proofs = int(os.environ.get("BENCH_PROOFS", "128"))
+    t_verify, per_proof = bench_verify(n_proofs)
+    t_rs = bench_rs_10gib()
+    total = t_verify + t_rs
+    extrapolated = t_rs + per_proof * 100_000
+    log(f"measured total (B={n_proofs} + 10GiB RS): {total:.2f}s; "
+        f"100k-extrapolation {extrapolated:.1f}s")
     print(
         json.dumps(
             {
-                "metric": "north_star_dataplane_s",
-                "value": round(projected, 3),
+                "metric": f"podr2_verify{n_proofs}@1024x265+rs10gib_measured_s",
+                "value": round(total, 3),
                 "unit": "s",
-                "vs_baseline": round(60.0 / projected, 3),
+                "vs_baseline": round(60.0 / extrapolated, 4),
             }
         )
     )

@@ -1,0 +1,198 @@
+"""Checkpoint / resume: canonical state encoding, state hash, snapshot.
+
+The reference's chain database IS its checkpoint — nodes resume from the
+persisted state trie, bootstrap via GRANDPA warp sync, and migrate
+storage layouts on upgrade (reference: node/src/service.rs:259-263 warp
+sync; c-pallets/audit/src/migrations.rs:9-41 versioned migrations;
+node/src/cli.rs:48-66 ExportState/ImportBlocks).  This module provides
+the equivalents for the framework's in-memory runtime:
+
+ * `state_encode(rt)` — a CANONICAL byte encoding of every pallet's
+   storage (sorted keys, type-tagged, closed under the value types the
+   pallets use).  Two runtimes that executed the same extrinsics encode
+   identically, byte for byte.
+ * `state_hash(rt)` — sha256 of the encoding: the replay-determinism
+   anchor (same genesis + same extrinsics ⇒ same hash), asserted in
+   tests/test_checkpoint.py.
+ * `snapshot(rt)` / `restore(rt, blob)` — ExportState/warp-sync shape:
+   extract the pure data state, then load it into a FRESHLY CONSTRUCTED
+   runtime (same genesis config).  Cross-pallet references, injected
+   verifiers, and backends are re-created by construction, not
+   serialized — only chain state travels.
+
+What counts as state: plain data attributes (ints, strings, bytes,
+bools, lists/tuples/sets/dicts/dataclasses of the same) reachable from
+the runtime's pallets, the balance ledger, the scheduler agenda, events,
+block number, and randomness.  Callables, pallet cross-references, the
+ProofBackend, and config objects are structural, not state — the
+extractor skips them and `restore` leaves the fresh runtime's own wiring
+in place.  (Off-chain actors' stores — the node sim's miner fragment
+stores — are not chain state, exactly as miner disks are not part of the
+reference's chain DB.)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import pickle
+from typing import Any
+
+_PALLETS = (
+    "state",
+    "sminer",
+    "storage_handler",
+    "oss",
+    "cacher",
+    "scheduler_credit",
+    "staking",
+    "tee_worker",
+    "file_bank",
+    "audit",
+)
+
+
+def _is_data(value: Any) -> bool:
+    if value is None or isinstance(value, (bool, int, str, bytes, float)):
+        return True
+    if isinstance(value, (list, tuple, set, frozenset)):
+        return all(_is_data(v) for v in value)
+    if isinstance(value, dict):
+        return all(_is_data(k) and _is_data(v) for k, v in value.items())
+    if dataclasses.is_dataclass(value) and not isinstance(value, type):
+        return all(
+            _is_data(getattr(value, f.name))
+            for f in dataclasses.fields(value)
+        )
+    return False
+
+
+# Injected-callable slots: wiring, never state — excluded even when unset
+# (None), so the hash does not depend on whether a verifier is plugged in.
+_WIRING_FIELDS = {"result_verifier", "cert_verifier"}
+
+
+def _object_state(obj: Any) -> dict[str, Any]:
+    """The data attributes of a pallet-like object (excludes wiring)."""
+    out = {}
+    for name, value in vars(obj).items():
+        if name in _WIRING_FIELDS:
+            continue
+        if _is_data(value):
+            out[name] = value
+        elif name.startswith("_"):
+            # private wiring (e.g. Balances._state back-reference) — the
+            # data-bearing privates (Agenda._by_block/_names) are plain
+            # data and took the branch above.
+            continue
+        elif type(value).__module__.startswith("cess_tpu.chain") and hasattr(
+            value, "__dict__"
+        ) and not callable(value):
+            # nested helper objects holding data (Balances, Agenda)
+            nested = _object_state(value)
+            if nested:
+                out[name] = ("__nested__", type(value).__name__, nested)
+    return out
+
+
+def _extract(rt) -> dict[str, dict[str, Any]]:
+    return {name: _object_state(getattr(rt, name)) for name in _PALLETS}
+
+
+def _apply(obj: Any, data: dict[str, Any]) -> None:
+    for name, value in data.items():
+        if (
+            isinstance(value, tuple)
+            and len(value) == 3
+            and value[0] == "__nested__"
+        ):
+            _apply(getattr(obj, name), value[2])
+        else:
+            setattr(obj, name, value)
+
+
+# ---------------------------------------------------------------- encode
+
+
+def _canon(value: Any, out: list[bytes]) -> None:
+    """Type-tagged canonical serialization (sorted mappings/sets)."""
+    if value is None:
+        out.append(b"N")
+    elif isinstance(value, bool):
+        out.append(b"B1" if value else b"B0")
+    elif isinstance(value, int):
+        raw = value.to_bytes(
+            (value.bit_length() + 8) // 8 or 1, "big", signed=True
+        )
+        out.append(b"I" + len(raw).to_bytes(4, "big") + raw)
+    elif isinstance(value, float):
+        out.append(b"F" + repr(value).encode())
+    elif isinstance(value, str):
+        raw = value.encode()
+        out.append(b"S" + len(raw).to_bytes(4, "big") + raw)
+    elif isinstance(value, bytes):
+        out.append(b"Y" + len(value).to_bytes(4, "big") + value)
+    elif isinstance(value, (list, tuple)):
+        out.append(b"L" + len(value).to_bytes(4, "big"))
+        for v in value:
+            _canon(v, out)
+    elif isinstance(value, (set, frozenset)):
+        parts: list[bytes] = []
+        for v in value:
+            sub: list[bytes] = []
+            _canon(v, sub)
+            parts.append(b"".join(sub))
+        parts.sort()
+        out.append(b"E" + len(parts).to_bytes(4, "big") + b"".join(parts))
+    elif isinstance(value, dict):
+        items: list[tuple[bytes, Any]] = []
+        for k, v in value.items():
+            sub: list[bytes] = []
+            _canon(k, sub)
+            items.append((b"".join(sub), v))
+        items.sort(key=lambda kv: kv[0])
+        out.append(b"D" + len(items).to_bytes(4, "big"))
+        for kraw, v in items:
+            out.append(kraw)
+            _canon(v, out)
+    elif dataclasses.is_dataclass(value) and not isinstance(value, type):
+        fields = dataclasses.fields(value)
+        out.append(
+            b"C"
+            + type(value).__name__.encode()
+            + b"/"
+            + len(fields).to_bytes(2, "big")
+        )
+        for f in fields:
+            _canon(f.name, out)
+            _canon(getattr(value, f.name), out)
+    else:  # pragma: no cover - _is_data filters these out
+        raise TypeError(f"non-canonical value {type(value)!r}")
+
+
+def state_encode(rt) -> bytes:
+    out: list[bytes] = []
+    _canon(_extract(rt), out)
+    return b"".join(out)
+
+
+def state_hash(rt) -> str:
+    """Deterministic hex digest of the full chain state."""
+    return hashlib.sha256(state_encode(rt)).hexdigest()
+
+
+# ---------------------------------------------------------------- snapshot
+
+
+def snapshot(rt) -> bytes:
+    """Serialized chain state (the ExportState role)."""
+    return pickle.dumps(_extract(rt), protocol=4)
+
+
+def restore(rt, blob: bytes) -> None:
+    """Load a snapshot into a freshly constructed runtime (same genesis
+    config).  Wiring (pallet cross-refs, verifiers, backend) stays as the
+    fresh construction made it; only data state is replaced."""
+    data = pickle.loads(blob)
+    for name, fields in data.items():
+        _apply(getattr(rt, name), fields)

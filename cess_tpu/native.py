@@ -144,3 +144,95 @@ def rs_reconstruct(
     if rc != 0:
         raise ValueError("rs_reconstruct failed")
     return [out.raw[i * shard_len : (i + 1) * shard_len] for i in range(k)]
+
+
+# ---------------------------------------------------------------- BLS hash
+
+_BLSMAP_READY = False
+
+
+def _blsmap_lib():
+    lib = load()
+    assert lib is not None, "native library not built (make -C native)"
+    if not hasattr(lib.cess_blsmap_init, "_configured"):
+        lib.cess_blsmap_init.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_uint64,
+            ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.c_uint64,
+        ]
+        lib.cess_blsmap_init.restype = ctypes.c_int
+        lib.cess_blsmap_hash_g1_batch.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.c_char_p, ctypes.c_uint64,
+        ]
+        lib.cess_blsmap_hash_g1_batch.restype = ctypes.c_int
+        lib.cess_blsmap_init._configured = True
+    return lib
+
+
+def blsmap_init() -> None:
+    """Feed the derived SSWU/isogeny constants (ops/_sswu_g1.py) and the
+    curve parameters into the native hash-to-curve kernel."""
+    global _BLSMAP_READY
+    if _BLSMAP_READY:
+        return
+    from .ops import _sswu_g1, bls12_381 as bls
+
+    lib = _blsmap_lib()
+
+    def be48(x: int) -> bytes:
+        return x.to_bytes(48, "big")
+
+    def vec(coeffs: list[int]) -> bytes:
+        return b"".join(be48(c) for c in coeffs)
+
+    rc = lib.cess_blsmap_init(
+        be48(bls.P), be48(_sswu_g1.A_PRIME), be48(_sswu_g1.B_PRIME),
+        _sswu_g1.Z_SSWU,
+        vec(_sswu_g1.X_NUM), len(_sswu_g1.X_NUM),
+        vec(_sswu_g1.X_DEN), len(_sswu_g1.X_DEN),
+        vec(_sswu_g1.Y_NUM), len(_sswu_g1.Y_NUM),
+        vec(_sswu_g1.Y_DEN), len(_sswu_g1.Y_DEN),
+        bls.H_EFF_G1,
+    )
+    if rc != 0:
+        raise RuntimeError(f"cess_blsmap_init failed: {rc}")
+    _BLSMAP_READY = True
+
+
+def hash_to_g1_batch(
+    msgs: list[bytes], dst: bytes, threads: int = 8
+) -> list[tuple[int, int]]:
+    """Batched hash-to-G1 (affine (x, y) ints), bit-identical to the host
+    reference ops/bls12_381.hash_to_g1 (tests/test_native.py).  Runs the
+    xmd/SSWU/isogeny/cofactor pipeline in native threads with the GIL
+    released — the verifier's random-oracle workhorse."""
+    blsmap_init()
+    lib = _blsmap_lib()
+    assert all(len(m) <= 1024 for m in msgs), "message too long"
+    assert len(dst) <= 255
+    blob = b"".join(msgs)
+    offs = (ctypes.c_uint64 * (len(msgs) + 1))()
+    acc = 0
+    for i, m in enumerate(msgs):
+        offs[i] = acc
+        acc += len(m)
+    offs[len(msgs)] = acc
+    out = ctypes.create_string_buffer(96 * len(msgs))
+    rc = lib.cess_blsmap_hash_g1_batch(
+        blob, offs, len(msgs), dst, len(dst), out, threads
+    )
+    if rc != 0:
+        raise RuntimeError(f"hash_g1_batch failed: {rc}")
+    res = []
+    for i in range(len(msgs)):
+        chunk = out.raw[96 * i : 96 * (i + 1)]
+        res.append(
+            (int.from_bytes(chunk[:48], "big"), int.from_bytes(chunk[48:], "big"))
+        )
+    return res

@@ -119,6 +119,32 @@ def chunk_point(name: bytes, index: int) -> G1Point:
     return bls.hash_to_g1(name + b"/" + index.to_bytes(8, "little"), H_DST)
 
 
+def chunk_points_batch(
+    pairs: list[tuple[bytes, int]], threads: int = 8
+) -> list[G1Point]:
+    """Batched H(name ‖ i) through the native hash-to-curve kernel
+    (native/blsmap.cpp) when built — bit-identical to chunk_point
+    (tests/test_native.py) — with a host fallback.  The verifier's
+    random-oracle workhorse: the combined check needs one point per
+    (proof, challenged chunk)."""
+    try:
+        from .. import native
+
+        msgs = [
+            name + b"/" + index.to_bytes(8, "little") for name, index in pairs
+        ]
+        out = []
+        for x, y in native.hash_to_g1_batch(msgs, H_DST, threads=threads):
+            out.append(
+                G1Point.infinity() if x == 0 and y == 0 else G1Point(x, y)
+            )
+        return out
+    except (AssertionError, AttributeError, OSError, RuntimeError):
+        # no native library, a stale build without the blsmap symbols, or
+        # an over-long message — the host path is always correct
+        return [chunk_point(name, index) for name, index in pairs]
+
+
 def split_sectors(chunk: bytes, s: int) -> list[int]:
     """Chunk bytes → s sector scalars (zero-padded little-endian)."""
     chunk = chunk.ljust(s * SECTOR_SIZE, b"\x00")

@@ -53,6 +53,18 @@ class XlaBackend(ProofBackend):
 
     def __init__(self, mesh=None) -> None:
         self.mesh = mesh
+        # H-point memo for one verify_batch call: the bisection tree
+        # re-visits identical (name, index) pairs across overlapping
+        # subsets; hash each pair once (the cached-chunk_point role of
+        # the host path, scoped to the call so memory stays bounded).
+        self._h_memo: dict[tuple[bytes, int], object] = {}
+
+    def _chunk_points(self, pairs: list[tuple[bytes, int]]) -> list:
+        missing = [p for p in pairs if p not in self._h_memo]
+        if missing:
+            for p, pt in zip(missing, podr2.chunk_points_batch(missing)):
+                self._h_memo[p] = pt
+        return [self._h_memo[p] for p in pairs]
 
     # ------------------------------------------------------------ verify
 
@@ -111,11 +123,17 @@ class XlaBackend(ProofBackend):
         lhs = g1.msm(sigmas, rhos, bits=_RHO_BITS)
 
         # H-side: per-item Π_c H^{v_c} (grouped MSM over the challenged
-        # chunk points), then the ρ fold across items.
-        h_pts = [
-            [podr2.chunk_point(name, i) for i in ch.indices]
-            for name, ch, _ in items
+        # chunk points, hashed through the native batch kernel), then the
+        # ρ fold across items.
+        flat_pairs = [
+            (name, i) for name, ch, _ in items for i in ch.indices
         ]
+        flat_pts = self._chunk_points(flat_pairs)
+        h_pts = []
+        pos = 0
+        for _, ch, _ in items:
+            h_pts.append(flat_pts[pos : pos + len(ch.indices)])
+            pos += len(ch.indices)
         h_coeffs = [list(ch.coefficients()) for _, ch, _ in items]
         inner = g1.msm_grouped(h_pts, h_coeffs, bits=_COEFF_BITS)
         rhs = g1.msm(inner, rhos, bits=_RHO_BITS)
@@ -139,9 +157,13 @@ class XlaBackend(ProofBackend):
             name, challenge, proof = item
             return podr2.verify(pk_, name, challenge, proof, s=params_.s)
 
-        return self._verdicts_by_bisection(
-            pk, items, seed, params, self._combined_check, single_check
-        )
+        self._h_memo = {}
+        try:
+            return self._verdicts_by_bisection(
+                pk, items, seed, params, self._combined_check, single_check
+            )
+        finally:
+            self._h_memo = {}
 
     # ------------------------------------------------------------ prove
 

@@ -105,3 +105,27 @@ class TestRs:
         native_parity = native.rs_encode(12, 4, [bytes(r) for r in data])
         jax_parity = np.asarray(RSCode(12, 4).encode(data))
         assert [bytes(r) for r in jax_parity] == native_parity
+
+
+class TestBlsMap:
+    """native/blsmap.cpp hash-to-curve vs the host reference — the
+    random-oracle batch path must be bit-identical (capability match:
+    utils/verify-bls-signatures/src/lib.rs:23-31)."""
+
+    def test_hash_batch_bit_identity(self, lib):
+        from cess_tpu import native
+        from cess_tpu.ops import bls12_381 as bls
+
+        msgs = [b"frag/%d" % i for i in range(6)] + [b"", b"\x00" * 64]
+        got = native.hash_to_g1_batch(msgs, bls.DST_G1)
+        for m, (x, y) in zip(msgs, got):
+            want = bls.hash_to_g1(m)
+            assert (x, y) == (want.x, want.y)
+
+    def test_chunk_points_batch_matches_single(self, lib):
+        from cess_tpu.ops import podr2
+
+        pairs = [(b"name-%d" % (i % 3), i * 7) for i in range(8)]
+        batch = podr2.chunk_points_batch(pairs)
+        singles = [podr2.chunk_point(n, i) for n, i in pairs]
+        assert batch == singles
