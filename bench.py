@@ -152,6 +152,10 @@ def bench_verify(n_proofs: int) -> tuple[float, float]:
 
 def main() -> None:
     n_proofs = int(os.environ.get("BENCH_PROOFS", "128"))
+    # power of two: the grouped MSM pads the batch to one anyway, and the
+    # marginal-slope calculation below assumes the padded lanes scale
+    # with the counted proofs
+    n_proofs = 1 << max(1, (n_proofs - 1).bit_length())
     t_verify, per_proof = bench_verify(n_proofs)
     t_rs = bench_rs_10gib()
     total = t_verify + t_rs

@@ -1,4 +1,4 @@
-"""Checkpoint / resume: canonical state encoding, state hash, snapshot.
+"""Checkpoint / resume: canonical state codec, state hash, snapshot.
 
 The reference's chain database IS its checkpoint — nodes resume from the
 persisted state trie, bootstrap via GRANDPA warp sync, and migrate
@@ -7,27 +7,29 @@ sync; c-pallets/audit/src/migrations.rs:9-41 versioned migrations;
 node/src/cli.rs:48-66 ExportState/ImportBlocks).  This module provides
 the equivalents for the framework's in-memory runtime:
 
- * `state_encode(rt)` — a CANONICAL byte encoding of every pallet's
-   storage (sorted keys, type-tagged, closed under the value types the
-   pallets use).  Two runtimes that executed the same extrinsics encode
-   identically, byte for byte.
+ * `state_encode(rt)` — a CANONICAL, type-tagged byte encoding of every
+   pallet's storage (sorted mappings, tuple/list distinguished, closed
+   under the value types the pallets use).  Two runtimes that executed
+   the same extrinsics encode identically, byte for byte.
  * `state_hash(rt)` — sha256 of the encoding: the replay-determinism
    anchor (same genesis + same extrinsics ⇒ same hash), asserted in
    tests/test_checkpoint.py.
- * `snapshot(rt)` / `restore(rt, blob)` — ExportState/warp-sync shape:
-   extract the pure data state, then load it into a FRESHLY CONSTRUCTED
-   runtime (same genesis config).  Cross-pallet references, injected
-   verifiers, and backends are re-created by construction, not
-   serialized — only chain state travels.
+ * `snapshot(rt)` / `restore(rt, blob)` — ExportState/warp-sync shape.
+   The blob IS the canonical encoding (state_hash(snapshot) is just
+   sha256 of the blob): a pure data format with its own decoder — no
+   pickle, so an untrusted blob can at worst fail to parse, never
+   execute code.  Restoring loads the data into a FRESHLY CONSTRUCTED
+   runtime (same genesis config); wiring — pallet cross-references,
+   injected verifiers, backends — is re-created by construction and
+   never travels.
 
-What counts as state: plain data attributes (ints, strings, bytes,
-bools, lists/tuples/sets/dicts/dataclasses of the same) reachable from
-the runtime's pallets, the balance ledger, the scheduler agenda, events,
-block number, and randomness.  Callables, pallet cross-references, the
-ProofBackend, and config objects are structural, not state — the
-extractor skips them and `restore` leaves the fresh runtime's own wiring
-in place.  (Off-chain actors' stores — the node sim's miner fragment
-stores — are not chain state, exactly as miner disks are not part of the
+Attribute classification is LOUD: plain data is captured; known
+structural values (pallet cross-references, ChainState back-refs,
+callables, the nested Balances/Agenda helpers) are skipped or recursed
+by explicit rule; anything else raises, so a new pallet field of an
+unsupported type fails tests instead of silently vanishing from the
+hash.  (Off-chain actors' stores — the node sim's miner fragment stores
+— are not chain state, exactly as miner disks are not part of the
 reference's chain DB.)
 """
 
@@ -35,7 +37,6 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
-import pickle
 from typing import Any
 
 _PALLETS = (
@@ -50,6 +51,24 @@ _PALLETS = (
     "file_bank",
     "audit",
 )
+
+# Nested data-bearing helpers the extractor recurses into.
+_NESTED_TYPES = {"Balances", "Agenda"}
+
+# Injected-callable slots: wiring, never state — excluded even when unset
+# (None), so the hash does not depend on whether a verifier is plugged in.
+_WIRING_FIELDS = {"result_verifier", "cert_verifier"}
+
+
+def _is_structural(value: Any) -> bool:
+    """Pallet cross-references and similar wiring reachable from pallet
+    attributes — reconstructed by Runtime.__init__, never serialized."""
+    tname = type(value).__name__
+    return (
+        callable(value)
+        or tname.endswith("Pallet")
+        or tname in ("ChainState", "Runtime", "RuntimeConfig")
+    )
 
 
 def _is_data(value: Any) -> bool:
@@ -67,42 +86,42 @@ def _is_data(value: Any) -> bool:
     return False
 
 
-# Injected-callable slots: wiring, never state — excluded even when unset
-# (None), so the hash does not depend on whether a verifier is plugged in.
-_WIRING_FIELDS = {"result_verifier", "cert_verifier"}
-
-
-def _object_state(obj: Any) -> dict[str, Any]:
-    """The data attributes of a pallet-like object (excludes wiring)."""
+def _object_state(obj: Any, where: str) -> dict[str, Any]:
+    """The data attributes of a pallet-like object.  Loud on anything
+    that is neither data nor a recognized structural reference."""
     out = {}
     for name, value in vars(obj).items():
         if name in _WIRING_FIELDS:
             continue
         if _is_data(value):
             out[name] = value
-        elif name.startswith("_"):
-            # private wiring (e.g. Balances._state back-reference) — the
-            # data-bearing privates (Agenda._by_block/_names) are plain
-            # data and took the branch above.
+        elif _is_structural(value):
             continue
-        elif type(value).__module__.startswith("cess_tpu.chain") and hasattr(
-            value, "__dict__"
-        ) and not callable(value):
-            # nested helper objects holding data (Balances, Agenda)
-            nested = _object_state(value)
-            if nested:
-                out[name] = ("__nested__", type(value).__name__, nested)
+        elif type(value).__name__ in _NESTED_TYPES:
+            out[name] = (
+                "__nested__",
+                type(value).__name__,
+                _object_state(value, f"{where}.{name}"),
+            )
+        else:
+            raise TypeError(
+                f"{where}.{name}: {type(value).__name__} is neither chain "
+                "state nor recognized wiring — extend checkpoint.py "
+                "explicitly so it cannot be dropped silently"
+            )
     return out
 
 
 def _extract(rt) -> dict[str, dict[str, Any]]:
-    return {name: _object_state(getattr(rt, name)) for name in _PALLETS}
+    return {
+        name: _object_state(getattr(rt, name), name) for name in _PALLETS
+    }
 
 
 def _apply(obj: Any, data: dict[str, Any]) -> None:
     for name, value in data.items():
         if (
-            isinstance(value, tuple)
+            isinstance(value, (tuple, list))
             and len(value) == 3
             and value[0] == "__nested__"
         ):
@@ -111,11 +130,12 @@ def _apply(obj: Any, data: dict[str, Any]) -> None:
             setattr(obj, name, value)
 
 
-# ---------------------------------------------------------------- encode
+# ---------------------------------------------------------------- codec
+# Type-tagged canonical serialization: N/B/I/F/S/Y scalars, L list,
+# T tuple, E set, e frozenset, D dict (sorted), C dataclass.
 
 
 def _canon(value: Any, out: list[bytes]) -> None:
-    """Type-tagged canonical serialization (sorted mappings/sets)."""
     if value is None:
         out.append(b"N")
     elif isinstance(value, bool):
@@ -126,24 +146,27 @@ def _canon(value: Any, out: list[bytes]) -> None:
         )
         out.append(b"I" + len(raw).to_bytes(4, "big") + raw)
     elif isinstance(value, float):
-        out.append(b"F" + repr(value).encode())
+        raw = repr(value).encode()
+        out.append(b"F" + len(raw).to_bytes(2, "big") + raw)
     elif isinstance(value, str):
         raw = value.encode()
         out.append(b"S" + len(raw).to_bytes(4, "big") + raw)
     elif isinstance(value, bytes):
         out.append(b"Y" + len(value).to_bytes(4, "big") + value)
     elif isinstance(value, (list, tuple)):
-        out.append(b"L" + len(value).to_bytes(4, "big"))
+        tag = b"L" if isinstance(value, list) else b"T"
+        out.append(tag + len(value).to_bytes(4, "big"))
         for v in value:
             _canon(v, out)
     elif isinstance(value, (set, frozenset)):
+        tag = b"E" if isinstance(value, set) else b"e"
         parts: list[bytes] = []
         for v in value:
             sub: list[bytes] = []
             _canon(v, sub)
             parts.append(b"".join(sub))
         parts.sort()
-        out.append(b"E" + len(parts).to_bytes(4, "big") + b"".join(parts))
+        out.append(tag + len(parts).to_bytes(4, "big") + b"".join(parts))
     elif isinstance(value, dict):
         items: list[tuple[bytes, Any]] = []
         for k, v in value.items():
@@ -157,17 +180,100 @@ def _canon(value: Any, out: list[bytes]) -> None:
             _canon(v, out)
     elif dataclasses.is_dataclass(value) and not isinstance(value, type):
         fields = dataclasses.fields(value)
+        cname = type(value).__name__.encode()
         out.append(
             b"C"
-            + type(value).__name__.encode()
-            + b"/"
+            + len(cname).to_bytes(1, "big")
+            + cname
             + len(fields).to_bytes(2, "big")
         )
         for f in fields:
             _canon(f.name, out)
             _canon(getattr(value, f.name), out)
-    else:  # pragma: no cover - _is_data filters these out
+    else:  # pragma: no cover - _object_state filters these out
         raise TypeError(f"non-canonical value {type(value)!r}")
+
+
+class _Reader:
+    def __init__(self, data: bytes, registry: dict[str, type]) -> None:
+        self.data = data
+        self.off = 0
+        self.registry = registry
+
+    def take(self, n: int) -> bytes:
+        if self.off + n > len(self.data):
+            raise ValueError("truncated snapshot")
+        out = self.data[self.off : self.off + n]
+        self.off += n
+        return out
+
+    def read(self) -> Any:
+        tag = self.take(1)
+        if tag == b"N":
+            return None
+        if tag == b"B":
+            return self.take(1) == b"1"
+        if tag == b"I":
+            n = int.from_bytes(self.take(4), "big")
+            return int.from_bytes(self.take(n), "big", signed=True)
+        if tag == b"F":
+            n = int.from_bytes(self.take(2), "big")
+            return float(self.take(n).decode())
+        if tag == b"S":
+            n = int.from_bytes(self.take(4), "big")
+            return self.take(n).decode()
+        if tag == b"Y":
+            n = int.from_bytes(self.take(4), "big")
+            return self.take(n)
+        if tag in (b"L", b"T"):
+            n = int.from_bytes(self.take(4), "big")
+            items = [self.read() for _ in range(n)]
+            return items if tag == b"L" else tuple(items)
+        if tag in (b"E", b"e"):
+            n = int.from_bytes(self.take(4), "big")
+            items = {self.read() for _ in range(n)}
+            return items if tag == b"E" else frozenset(items)
+        if tag == b"D":
+            n = int.from_bytes(self.take(4), "big")
+            out = {}
+            for _ in range(n):
+                k = self.read()
+                out[k] = self.read()
+            return out
+        if tag == b"C":
+            cn = int.from_bytes(self.take(1), "big")
+            cname = self.take(cn).decode()
+            nfields = int.from_bytes(self.take(2), "big")
+            fields = {}
+            for _ in range(nfields):
+                fname = self.read()
+                fields[fname] = self.read()
+            cls = self.registry.get(cname)
+            if cls is None:
+                raise ValueError(f"unknown dataclass {cname!r} in snapshot")
+            return cls(**fields)
+        raise ValueError(f"bad tag {tag!r} in snapshot")
+
+
+def _dataclass_registry() -> dict[str, type]:
+    """name → class for every dataclass defined in the chain package (the
+    value types pallet storages hold)."""
+    import importlib
+    import pkgutil
+
+    import cess_tpu.chain as pkg
+
+    out: dict[str, type] = {}
+    for info in pkgutil.iter_modules(pkg.__path__):
+        mod = importlib.import_module(f"cess_tpu.chain.{info.name}")
+        for name in dir(mod):
+            obj = getattr(mod, name)
+            if isinstance(obj, type) and dataclasses.is_dataclass(obj):
+                out[obj.__name__] = obj
+    return out
+
+
+# ---------------------------------------------------------------- API
 
 
 def state_encode(rt) -> bytes:
@@ -181,18 +287,21 @@ def state_hash(rt) -> str:
     return hashlib.sha256(state_encode(rt)).hexdigest()
 
 
-# ---------------------------------------------------------------- snapshot
-
-
 def snapshot(rt) -> bytes:
-    """Serialized chain state (the ExportState role)."""
-    return pickle.dumps(_extract(rt), protocol=4)
+    """Serialized chain state (the ExportState role) — the canonical
+    encoding itself, so sha256(snapshot(rt)) == state_hash(rt)."""
+    return state_encode(rt)
 
 
 def restore(rt, blob: bytes) -> None:
     """Load a snapshot into a freshly constructed runtime (same genesis
     config).  Wiring (pallet cross-refs, verifiers, backend) stays as the
-    fresh construction made it; only data state is replaced."""
-    data = pickle.loads(blob)
+    fresh construction made it; only data state is replaced.  The blob is
+    parsed by the canonical decoder — malformed input raises ValueError,
+    nothing in the format can execute code."""
+    reader = _Reader(blob, _dataclass_registry())
+    data = reader.read()
+    if reader.off != len(blob):
+        raise ValueError("trailing bytes in snapshot")
     for name, fields in data.items():
         _apply(getattr(rt, name), fields)

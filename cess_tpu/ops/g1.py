@@ -193,6 +193,17 @@ def _norm(x: jnp.ndarray, passes: int) -> jnp.ndarray:
     return x
 
 
+# Inside a Pallas kernel the fold/pad tables must come from kernel inputs
+# (Pallas rejects captured array constants); the kernel installs them in
+# this context variable for the duration of its trace.  A ContextVar (not
+# a bare global) keeps concurrent traces from seeing each other's Refs.
+import contextvars
+
+_TABLE_OVERRIDE: contextvars.ContextVar[dict | None] = contextvars.ContextVar(
+    "g1_table_override", default=None
+)
+
+
 def _fold(x: jnp.ndarray, rounds: int) -> jnp.ndarray:
     """Normalized limbs (any length, each ≤ 4096) → loose (33, …) limbs,
     congruent mod p.  Each round tensordots the limbs ≥ 32 against the
@@ -204,8 +215,24 @@ def _fold(x: jnp.ndarray, rounds: int) -> jnp.ndarray:
     for _ in range(rounds):
         k = x.shape[0]
         low, high = x[:NP_LIMBS], x[NP_LIMBS:]
-        table = jnp.asarray(_pow_table(NP_LIMBS, k - NP_LIMBS))
-        folded = jnp.tensordot(table.T, high, axes=1)  # (32, …)
+        override = _TABLE_OVERRIDE.get()
+        if override is not None:
+            # Pallas path: Mosaic has no int32 matmul — expand the small
+            # contraction as a broadcast multiply-add over the ≤35 rows.
+            if k - NP_LIMBS not in override["pow"]:
+                raise KeyError(
+                    f"no Pallas fold table for {k - NP_LIMBS} high limbs —"
+                    " _FOLD_HIGHS must list every padding the field ops use"
+                )
+            table = override["pow"][k - NP_LIMBS]  # (K, 32)
+            folded = jnp.zeros((NP_LIMBS,) + x.shape[1:], jnp.int32)
+            for kk in range(table.shape[0]):
+                folded = folded + table[kk].reshape(
+                    (NP_LIMBS,) + (1,) * (x.ndim - 1)
+                ) * high[kk : kk + 1]
+        else:
+            table = jnp.asarray(_pow_table(NP_LIMBS, k - NP_LIMBS))
+            folded = jnp.tensordot(table.T, high, axes=1)  # (32, …)
         x = jnp.pad(low, [(0, 2)] + tail) + jnp.pad(folded, [(0, 2)] + tail)
         # dot sums ≤ 35·4096·4095 < 2^31; three passes restore ≤ 4096.
         x = _norm(x, 3)
@@ -235,7 +262,11 @@ def addm(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 
 def subm(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    pad = jnp.asarray(_sub_pad()).reshape((L,) + (1,) * (a.ndim - 1))
+    override = _TABLE_OVERRIDE.get()
+    if override is not None:
+        pad = override["subpad"]
+    else:
+        pad = jnp.asarray(_sub_pad()).reshape((L,) + (1,) * (a.ndim - 1))
     s = jnp.pad(a + pad - b, [(0, 1)] + [(0, 0)] * (a.ndim - 1))
     return _fold(_norm(s, 2), rounds=1)
 
@@ -353,11 +384,109 @@ def tree_reduce(points, axis_size: int):
     return X[..., 0], Y[..., 0], Z[..., 0]
 
 
+# ------------------------------------------------------------- pallas path
+
+
+def _ladder_tile_kernel(s_ref, X_ref, Y_ref, Z_ref, t35_ref, t3_ref, t2_ref,
+                        pad_ref, oX_ref, oY_ref, oZ_ref, *, bits: int):
+    """One VMEM-resident tile of the double-and-add ladder: the whole bit
+    loop runs on-chip with no HBM round-trips between steps — the XLA
+    per-op path materializes ~50 intermediate (33, N) arrays per bit and
+    is bandwidth-bound; this kernel is compute-bound on the VPU.  The
+    fold/pad tables arrive as inputs (Pallas forbids captured array
+    constants) and are installed via _TABLE_OVERRIDE for the trace."""
+    from jax.experimental import pallas as pl
+
+    P = (X_ref[:], Y_ref[:], Z_ref[:])
+    zero = jnp.zeros_like(P[0])
+    # (no scatter in Pallas: build "limb 0 = 1" with an iota mask)
+    limb0 = jax.lax.broadcasted_iota(jnp.int32, zero.shape, 0) == 0
+    one = jnp.where(limb0, 1, 0)
+
+    token = _TABLE_OVERRIDE.set(
+        {
+            "pow": {
+                h: ref[:]
+                for h, ref in zip(_FOLD_HIGHS, (t35_ref, t3_ref, t2_ref))
+            },
+            "subpad": pad_ref[:],
+        }
+    )
+    try:
+
+        def body(i, acc):
+            acc = pt_double(acc)
+            sX, sY, sZ = pt_add(acc, P)
+            j = bits - 1 - i
+            # dynamic VALUE slicing is not lowerable in-loop; a dynamic
+            # REF slice (pl.ds) is
+            limb = s_ref[pl.ds(j // LIMB_BITS, 1), :][0]
+            bit = ((limb >> (j % LIMB_BITS)) & 1) == 1
+            return (
+                _select(bit, sX, acc[0]),
+                _select(bit, sY, acc[1]),
+                _select(bit, sZ, acc[2]),
+            )
+
+        aX, aY, aZ = jax.lax.fori_loop(0, bits, body, (zero, one, zero))
+    finally:
+        _TABLE_OVERRIDE.reset(token)
+    oX_ref[:] = aX
+    oY_ref[:] = aY
+    oZ_ref[:] = aZ
+
+
+_PALLAS_TILE = 512
+
+# Every distinct high-limb count the field ops feed _fold: mulm pads its
+# 65-limb product by 2 (→ 35 high limbs), smallmul pads by 2 (→ 3),
+# addm/subm pad by 1 (→ 2).  The Pallas kernel carries one table per
+# entry; _fold raises if an op introduces a width not listed here.
+_FOLD_HIGHS = (35, 3, 2)
+
+
+def _batch_scalar_mul_pallas(points, scalars, bits: int):
+    """Pallas ladder over (33, N) batches, tiled along the lane axis.
+    N must be a power of two (callers pad)."""
+    from jax.experimental import pallas as pl
+
+    X, Y, Z = points
+    n = X.shape[1]
+    tile = min(_PALLAS_TILE, n)
+    spec_pt = pl.BlockSpec((L, tile), lambda i: (0, i))
+    spec_sc = pl.BlockSpec((R_LIMBS, tile), lambda i: (0, i))
+
+    t35, t3, t2 = (
+        jnp.asarray(_pow_table(NP_LIMBS, h)) for h in _FOLD_HIGHS
+    )
+    padv = jnp.asarray(_sub_pad()).reshape(L, 1)
+    full = lambda a: pl.BlockSpec(a.shape, lambda i: (0,) * a.ndim)  # noqa: E731
+
+    shape = jax.ShapeDtypeStruct((L, n), jnp.int32)
+    return pl.pallas_call(
+        partial(_ladder_tile_kernel, bits=bits),
+        grid=(n // tile,),
+        in_specs=[
+            spec_sc, spec_pt, spec_pt, spec_pt,
+            full(t35), full(t3), full(t2), full(padv),
+        ],
+        out_specs=[spec_pt, spec_pt, spec_pt],
+        out_shape=[shape, shape, shape],
+    )(scalars, X, Y, Z, t35, t3, t2, padv)
+
+
+def _use_pallas() -> bool:
+    return jax.default_backend() == "tpu"
+
+
 @partial(jax.jit, static_argnames=("bits", "group"))
 def _msm_kernel(X, Y, Z, scalars, bits=SCALAR_BITS, group=None):
     """(33, N) inputs → per-group MSM.  group=None sums the whole batch
     (result batch 1); group=g reshapes N = B·g and sums within groups."""
-    acc = batch_scalar_mul((X, Y, Z), scalars, bits=bits)
+    if _use_pallas():
+        acc = _batch_scalar_mul_pallas((X, Y, Z), scalars, bits=bits)
+    else:
+        acc = batch_scalar_mul((X, Y, Z), scalars, bits=bits)
     if group is not None:
         n = X.shape[1]
         acc = tuple(a.reshape(L, n // group, group) for a in acc)
@@ -478,6 +607,8 @@ def msm_grouped(
 
 @partial(jax.jit, static_argnames=("bits",))
 def _scalar_mul_kernel(X, Y, Z, scalars, bits=SCALAR_BITS):
+    if _use_pallas():
+        return _batch_scalar_mul_pallas((X, Y, Z), scalars, bits=bits)
     return batch_scalar_mul((X, Y, Z), scalars, bits=bits)
 
 
