@@ -50,6 +50,7 @@ _PALLETS = (
     "tee_worker",
     "file_bank",
     "audit",
+    "rrsc",
 )
 
 # Nested data-bearing helpers the extractor recurses into.

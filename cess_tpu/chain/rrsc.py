@@ -1,0 +1,96 @@
+"""RRSC stand-in: credit-weighted validator rotation + slot authorship.
+
+The reference's consensus is RRSC (Random Rotational Selection, a BABE
+fork living in the forked substrate — SURVEY.md §2 external components:
+`pallet_rrsc`/`cessc-consensus-rrsc`, runtime alias at
+runtime/src/lib.rs:1503).  Its two protocol-visible capabilities are:
+
+ * validator selection that folds TEE service reputation into the
+   election (the `ValidatorCredits` trait implemented by
+   scheduler-credit, c-pallets/scheduler-credit/src/lib.rs:242-251);
+ * slot-based block authorship driven by per-epoch randomness (the
+   `ParentBlockRandomness` the audit/file-bank pallets also consume,
+   runtime/src/lib.rs:1003,1069).
+
+This pallet re-expresses both against the framework's deterministic
+block loop: `rotate_epoch` runs the credit-weighted election
+(staking.elect × scheduler_credit.credits) and refreshes the epoch
+randomness; `slot_author` deterministically draws the block author from
+the active set, stake-weighted, from (epoch randomness, slot).  Real
+networking/finality remain out of scope (chain/node.py simulates the
+multi-role protocol in-process).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from .state import ChainState
+from .types import AccountId
+
+MOD = "rrsc"
+
+
+class RrscPallet:
+    def __init__(
+        self,
+        state: ChainState,
+        staking,
+        scheduler_credit,
+        max_validators: int = 100,
+    ) -> None:
+        self.state = state
+        self.staking = staking
+        self.scheduler_credit = scheduler_credit
+        self.max_validators = max_validators
+        self.epoch_index: int = 0
+        self.epoch_randomness: bytes = bytes(32)
+
+    # ------------------------------------------------------------ epochs
+
+    def rotate_epoch(self) -> list[AccountId]:
+        """Era-boundary rotation: elect the active set with TEE credit
+        weights and pin this epoch's randomness."""
+        # scheduler_credit.credits() is already stash-keyed (it resolves
+        # controller → stash through its SchedulerStashAccountFinder,
+        # the runtime/src/impls.rs:30-40 role).
+        credits = self.scheduler_credit.credits(self.epoch_index)
+        elected = self.staking.elect(
+            self.max_validators,
+            credits,
+            full_credit=self.scheduler_credit.full_credit(),
+        )
+        self.epoch_index += 1
+        self.epoch_randomness = self.state.randomness
+        self.state.deposit_event(
+            MOD, "NewEpoch", index=self.epoch_index, validators=len(elected)
+        )
+        return elected
+
+    # ------------------------------------------------------------ slots
+
+    def slot_author(self, slot: int) -> AccountId | None:
+        """Stake-weighted deterministic author draw for a slot — the
+        rotational-selection stand-in for BABE slot claims.  Every
+        validator replica computes the same author from shared state."""
+        validators = self.staking.validators
+        if not validators:
+            return None
+        weights = []
+        for v in validators:
+            ledger = self.staking.ledger.get(v)
+            weights.append(ledger.bonded if ledger else 1)
+        if not any(weights):
+            weights = [1] * len(validators)  # uniform fallback
+        total = sum(weights)
+        digest = hashlib.blake2b(
+            b"rrsc/slot" + self.epoch_randomness + slot.to_bytes(8, "little"),
+            digest_size=8,
+        ).digest()
+        draw = int.from_bytes(digest, "little") % total
+        acc = 0
+        for v, w in zip(validators, weights):
+            acc += w
+            if draw < acc:
+                return v
+        return validators[-1]

@@ -21,6 +21,7 @@ from dataclasses import dataclass, field
 
 from ..utils.hashing import blake2b_256
 from .audit import AuditPallet
+from .rrsc import RrscPallet
 from .cacher import CacherPallet
 from .file_bank import FileBankPallet
 from .oss import OssPallet
@@ -107,6 +108,7 @@ class Runtime:
             lock_time=cfg.audit_lock_time,
             chunk_count=cfg.podr2_chunk_count,
         )
+        self.rrsc = RrscPallet(self.state, self.staking, self.scheduler_credit)
 
         for acc, amount in cfg.endowed.items():
             self.state.balances.mint(acc, amount)
@@ -143,9 +145,13 @@ class Runtime:
         for call in self.state.agenda.take_due(now):
             self._dispatch_scheduled(call)
 
-        # Era rotation (session/staking stand-in).
+        # Era rotation (session/staking stand-in) + RRSC epoch rotation
+        # (credit-weighted election runs only when candidacies exist, so
+        # genesis-seeded authority sets stay put in minimal sims).
         if now % self.config.era_duration_blocks == 0:
             self.staking.end_era()
+            if self.staking.candidates:
+                self.rrsc.rotate_epoch()
 
     def _dispatch_scheduled(self, call: ScheduledCall) -> None:
         fn = self._dispatch.get((call.pallet, call.method))

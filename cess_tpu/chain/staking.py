@@ -36,11 +36,31 @@ REWARD_DECREASE_RATIO = Perbill(841_000_000)  # from_perthousand(841)
 REWARD_DECREASE_YEARS = 30
 
 
+# Unbonded funds stay locked for this many eras before withdrawal (the
+# stock pallet-staking BondingDuration the fork keeps).
+BONDING_DURATION_ERAS = 28
+
+# Reward/backing records older than this are pruned at era end (the
+# stock HistoryDepth role): unclaimed payouts expire, state stays bounded.
+HISTORY_DEPTH_ERAS = 84
+
+
+@dataclass
+class UnlockChunk:
+    value: Balance
+    era: int  # first era the chunk can be withdrawn in
+
+
 @dataclass
 class Ledger:
     stash: AccountId
     controller: AccountId
     bonded: Balance
+    unlocking: list = None  # list[UnlockChunk]
+
+    def __post_init__(self):
+        if self.unlocking is None:
+            self.unlocking = []
 
 
 class StakingPallet:
@@ -57,9 +77,13 @@ class StakingPallet:
         self.min_validator_bond = min_validator_bond
         self.bonded: dict[AccountId, AccountId] = {}  # stash -> controller
         self.ledger: dict[AccountId, Ledger] = {}  # stash -> ledger
-        self.validators: list[AccountId] = []  # stash accounts
+        self.validators: list[AccountId] = []  # ACTIVE set (stash accounts)
+        self.candidates: list[AccountId] = []  # validator candidacies
+        self.nominations: dict[AccountId, list[AccountId]] = {}
         self.active_era: int = 0
         self.eras_validator_reward: dict[int, Balance] = {}
+        self.era_backing: dict[int, dict[AccountId, dict[AccountId, Balance]]] = {}
+        self.payout_claimed: set[tuple[int, AccountId]] = set()
 
     # -- bonding ---------------------------------------------------------
 
@@ -73,10 +97,160 @@ class StakingPallet:
     def bonded_controller(self, stash: AccountId) -> AccountId | None:
         return self.bonded.get(stash)
 
+    def bond_extra(self, stash: AccountId, value: Balance) -> None:
+        ledger = self.ledger.get(stash)
+        ensure(ledger is not None, MOD, "NotStash")
+        self.state.balances.reserve(stash, value)
+        ledger.bonded += value
+        self.state.deposit_event(MOD, "Bonded", stash=stash, amount=value)
+
+    def unbond(self, stash: AccountId, value: Balance) -> None:
+        """Schedule `value` for unlock BONDING_DURATION eras out (stock
+        pallet-staking unbond shape the fork keeps)."""
+        ledger = self.ledger.get(stash)
+        ensure(ledger is not None, MOD, "NotStash")
+        ensure(0 < value <= ledger.bonded, MOD, "InsufficientBond")
+        ledger.bonded -= value
+        ledger.unlocking.append(
+            UnlockChunk(value, self.active_era + BONDING_DURATION_ERAS)
+        )
+        if (
+            stash in self.candidates
+            and ledger.bonded < self.min_validator_bond
+        ):
+            self.chill(stash)
+        self.state.deposit_event(MOD, "Unbonded", stash=stash, amount=value)
+
+    def withdraw_unbonded(self, stash: AccountId) -> Balance:
+        """Release every chunk whose era has arrived; returns the amount.
+        A fully-empty ledger is reaped (stash can re-bond afresh)."""
+        ledger = self.ledger.get(stash)
+        ensure(ledger is not None, MOD, "NotStash")
+        due = [c for c in ledger.unlocking if c.era <= self.active_era]
+        ledger.unlocking = [
+            c for c in ledger.unlocking if c.era > self.active_era
+        ]
+        amount = sum(c.value for c in due)
+        if amount:
+            self.state.balances.unreserve(stash, amount)
+            self.state.deposit_event(
+                MOD, "Withdrawn", stash=stash, amount=amount
+            )
+        if ledger.bonded == 0 and not ledger.unlocking:
+            del self.ledger[stash]
+            del self.bonded[stash]
+            self.nominations.pop(stash, None)
+            if stash in self.candidates:
+                self.candidates.remove(stash)
+            if stash in self.validators:
+                self.validators.remove(stash)
+        return amount
+
+    # -- intentions -------------------------------------------------------
+
+    def validate(self, stash: AccountId) -> None:
+        """Declare validator candidacy (stock `validate`)."""
+        ledger = self.ledger.get(stash)
+        ensure(ledger is not None, MOD, "NotStash")
+        ensure(
+            ledger.bonded >= self.min_validator_bond, MOD, "InsufficientBond"
+        )
+        if stash not in self.candidates:
+            self.candidates.append(stash)
+
+    def nominate(self, stash: AccountId, targets: list[AccountId]) -> None:
+        ensure(stash in self.ledger, MOD, "NotStash")
+        ensure(targets, MOD, "EmptyTargets")
+        ensure(
+            all(t in self.candidates for t in targets), MOD, "BadTarget"
+        )
+        self.nominations[stash] = list(dict.fromkeys(targets))
+
+    def chill(self, stash: AccountId) -> None:
+        if stash in self.candidates:
+            self.candidates.remove(stash)
+        self.nominations.pop(stash, None)
+
     def add_validator(self, stash: AccountId) -> None:
+        """Directly seat a validator (genesis/authority injection).  Does
+        NOT register candidacy: a directly-seated authority stays put
+        until real candidacies exist and an election replaces the set."""
         ensure(stash in self.bonded, MOD, "NotStash")
         if stash not in self.validators:
             self.validators.append(stash)
+
+    # -- election ---------------------------------------------------------
+
+    def backing_of(self, stash: AccountId) -> dict[AccountId, Balance]:
+        """who-backs-whom for one candidate: own bond + nominations."""
+        out: dict[AccountId, Balance] = {}
+        ledger = self.ledger.get(stash)
+        if ledger is not None and ledger.bonded:
+            out[stash] = ledger.bonded
+        for nom, targets in self.nominations.items():
+            if stash in targets:
+                nl = self.ledger.get(nom)
+                if nl is not None and nl.bonded:
+                    out[nom] = out.get(nom, 0) + nl.bonded // len(targets)
+        return out
+
+    def elect(
+        self, max_validators: int, credits: dict[AccountId, int] | None = None,
+        full_credit: int = 1000,
+    ) -> list[AccountId]:
+        """Credit-weighted validator selection — the RRSC/ValidatorCredits
+        role (reference: the forked consensus consumes
+        scheduler-credit's ValidatorCredits impl,
+        c-pallets/scheduler-credit/src/lib.rs:242-251): each candidate's
+        total backing is scaled by (full + credit)/full, so TEE service
+        reputation tilts the election.  Deterministic: ties break on the
+        account id."""
+        credits = credits or {}
+        scored = []
+        backings: dict[AccountId, dict[AccountId, Balance]] = {}
+        for stash in self.candidates:
+            ledger = self.ledger.get(stash)
+            if ledger is None or ledger.bonded < self.min_validator_bond:
+                continue
+            backings[stash] = self.backing_of(stash)
+            weight = full_credit + credits.get(stash, 0)
+            scored.append(
+                (sum(backings[stash].values()) * weight // full_credit, stash)
+            )
+        scored.sort(key=lambda t: (-t[0], t[1]))
+        elected = [s for _, s in scored[:max_validators]]
+        self.validators = elected
+        self.era_backing[self.active_era] = {s: backings[s] for s in elected}
+        return elected
+
+    # -- payout -----------------------------------------------------------
+
+    def payout_stakers(self, era: int, stash: AccountId) -> Balance:
+        """Pay one validator's era share, split pro-rata over its backers
+        (stock payout_stakers shape, commission 0).  The era pool divides
+        across the elected set by backing weight."""
+        ensure((era, stash) not in self.payout_claimed, MOD, "AlreadyClaimed")
+        pool = self.eras_validator_reward.get(era)
+        ensure(pool is not None, MOD, "InvalidEraToReward")
+        backing = self.era_backing.get(era, {})
+        ensure(stash in backing, MOD, "NotElected")
+        total_all = sum(sum(b.values()) for b in backing.values())
+        mine = backing[stash]
+        total_mine = sum(mine.values())
+        if total_all == 0 or total_mine == 0:
+            return 0
+        share = pool * total_mine // total_all
+        paid = 0
+        for backer, amount in sorted(mine.items()):
+            cut = share * amount // total_mine
+            if cut:
+                self.state.balances.mint(backer, cut)
+                paid += cut
+        self.payout_claimed.add((era, stash))
+        self.state.deposit_event(
+            MOD, "Rewarded", stash=stash, era=era, amount=paid
+        )
+        return paid
 
     # -- era economics ----------------------------------------------------
 
@@ -109,6 +283,14 @@ class StakingPallet:
         self.eras_validator_reward[self.active_era] = validator_payout
         self.sminer.on_unbalanced(sminer_payout)
         self.active_era += 1
+        # HistoryDepth pruning: expire stale reward/backing/claim records
+        horizon = self.active_era - HISTORY_DEPTH_ERAS
+        if horizon >= 0:
+            self.eras_validator_reward.pop(horizon, None)
+            self.era_backing.pop(horizon, None)
+            self.payout_claimed = {
+                (era, s) for era, s in self.payout_claimed if era > horizon
+            }
 
     # -- slashing ----------------------------------------------------------
 

@@ -21,3 +21,28 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--runslow", action="store_true", default=False,
+        help="run slow (protocol-geometry) tests",
+    )
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: protocol-geometry tests (minutes of compiles)"
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    import pytest as _pytest
+
+    run_slow = os.environ.get("RUN_SLOW", "") not in ("", "0", "false")
+    if config.getoption("--runslow") or run_slow:
+        return
+    skip = _pytest.mark.skip(reason="slow; use --runslow or RUN_SLOW=1")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)

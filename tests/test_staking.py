@@ -1,0 +1,130 @@
+"""Staking completeness + RRSC rotation: bond/unbond/withdraw lifecycle,
+nomination-backed credit-weighted election, era payout distribution, and
+deterministic slot authorship (reference:
+c-pallets/staking/src/pallet/impls.rs:432-475 for the era economics,
+scheduler-credit's ValidatorCredits at
+c-pallets/scheduler-credit/src/lib.rs:242-251 for the election weights)."""
+
+import pytest
+
+from cess_tpu.chain.runtime import Runtime, RuntimeConfig
+from cess_tpu.chain.staking import BONDING_DURATION_ERAS
+from cess_tpu.chain.types import DispatchError, TOKEN
+
+
+def make_rt(**endowed):
+    accounts = {a: 1_000_000 * TOKEN for a in endowed.get("accounts", [])}
+    return Runtime(RuntimeConfig(endowed=accounts))
+
+
+@pytest.fixture
+def rt():
+    return make_rt(accounts=["alice", "bob", "carol", "dave", "nom"])
+
+
+class TestBonding:
+    def test_unbond_locks_for_bonding_duration(self, rt):
+        rt.staking.bond("alice", "alice-c", 10_000 * TOKEN)
+        rt.staking.unbond("alice", 4_000 * TOKEN)
+        assert rt.staking.ledger["alice"].bonded == 6_000 * TOKEN
+        # nothing withdrawable yet
+        assert rt.staking.withdraw_unbonded("alice") == 0
+        assert rt.state.balances.reserved("alice") == 10_000 * TOKEN
+        # advance past the bonding duration
+        for _ in range(BONDING_DURATION_ERAS):
+            rt.staking.end_era()
+        assert rt.staking.withdraw_unbonded("alice") == 4_000 * TOKEN
+        assert rt.state.balances.reserved("alice") == 6_000 * TOKEN
+
+    def test_full_unbond_reaps_ledger(self, rt):
+        rt.staking.bond("bob", "bob-c", 5_000 * TOKEN)
+        rt.staking.unbond("bob", 5_000 * TOKEN)
+        for _ in range(BONDING_DURATION_ERAS):
+            rt.staking.end_era()
+        rt.staking.withdraw_unbonded("bob")
+        assert "bob" not in rt.staking.ledger
+        assert "bob" not in rt.staking.bonded
+        # can re-bond afresh
+        rt.staking.bond("bob", "bob-c", 1_000 * TOKEN)
+
+    def test_unbond_below_min_bond_chills_candidacy(self, rt):
+        rt.staking.bond("carol", "carol-c", 6_000 * TOKEN)
+        rt.staking.validate("carol")
+        assert "carol" in rt.staking.candidates
+        rt.staking.unbond("carol", 2_000 * TOKEN)  # below 5k min
+        assert "carol" not in rt.staking.candidates
+
+    def test_overdraw_rejected(self, rt):
+        rt.staking.bond("dave", "dave-c", 1_000 * TOKEN)
+        with pytest.raises(DispatchError, match="InsufficientBond"):
+            rt.staking.unbond("dave", 2_000 * TOKEN)
+
+
+class TestElection:
+    def seed(self, rt):
+        rt.staking.bond("alice", "a-c", 10_000 * TOKEN)
+        rt.staking.bond("bob", "b-c", 20_000 * TOKEN)
+        rt.staking.bond("carol", "c-c", 30_000 * TOKEN)
+        rt.staking.bond("nom", "n-c", 40_000 * TOKEN)
+        for v in ("alice", "bob", "carol"):
+            rt.staking.validate(v)
+
+    def test_stake_orders_election(self, rt):
+        self.seed(rt)
+        assert rt.staking.elect(2) == ["carol", "bob"]
+
+    def test_nomination_backs_candidate(self, rt):
+        self.seed(rt)
+        rt.staking.nominate("nom", ["alice"])
+        # alice: 10k own + 40k nominated = 50k > carol's 30k
+        assert rt.staking.elect(2) == ["alice", "carol"]
+
+    def test_credit_weight_tilts_election(self, rt):
+        """The ValidatorCredits role: a full-credit TEE validator beats a
+        larger raw stake (reference: scheduler-credit lib.rs:242-251)."""
+        self.seed(rt)
+        # bob at 20k with full credit (x2) outranks carol's 30k
+        assert rt.staking.elect(2, credits={"bob": 1000}) == ["bob", "carol"]
+
+    def test_payout_distributes_pro_rata(self, rt):
+        self.seed(rt)
+        rt.staking.nominate("nom", ["carol"])
+        rt.staking.elect(2)
+        era = rt.staking.active_era
+        rt.staking.end_era()
+        pool = rt.staking.eras_validator_reward[era]
+        free_before = {
+            a: rt.state.balances.free(a) for a in ("carol", "nom", "bob")
+        }
+        paid_carol = rt.staking.payout_stakers(era, "carol")
+        paid_bob = rt.staking.payout_stakers(era, "bob")
+        assert 0 < paid_carol + paid_bob <= pool
+        # carol's backing (30k own + 40k nom) > bob's 20k ⇒ bigger share,
+        # and the nominator gets its pro-rata cut
+        assert paid_carol > paid_bob
+        assert rt.state.balances.free("nom") > free_before["nom"]
+        with pytest.raises(DispatchError, match="AlreadyClaimed"):
+            rt.staking.payout_stakers(era, "carol")
+
+
+class TestRrsc:
+    def test_rotation_elects_and_rotates_randomness(self, rt):
+        rt.staking.bond("alice", "a-c", 10_000 * TOKEN)
+        rt.staking.bond("bob", "b-c", 20_000 * TOKEN)
+        rt.staking.validate("alice")
+        rt.staking.validate("bob")
+        rt.run_blocks(rt.config.era_duration_blocks)
+        assert rt.rrsc.epoch_index >= 1
+        assert rt.staking.validators  # elected set active
+        assert rt.rrsc.epoch_randomness != bytes(32)
+
+    def test_slot_author_deterministic_and_weighted(self, rt):
+        rt.staking.bond("alice", "a-c", 10_000 * TOKEN)
+        rt.staking.bond("bob", "b-c", 90_000 * TOKEN)
+        rt.staking.validate("alice")
+        rt.staking.validate("bob")
+        rt.run_blocks(rt.config.era_duration_blocks)
+        authors = [rt.rrsc.slot_author(s) for s in range(200)]
+        assert authors == [rt.rrsc.slot_author(s) for s in range(200)]
+        # stake-weighted: bob (90%) must author the strong majority
+        assert authors.count("bob") > authors.count("alice")
