@@ -171,6 +171,19 @@ def _blsmap_lib():
             ctypes.c_char_p, ctypes.c_uint64,
         ]
         lib.cess_blsmap_hash_g1_batch.restype = ctypes.c_int
+        lib.cess_blsmap_xmd_u_batch.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
+        ]
+        lib.cess_blsmap_xmd_u_batch.restype = ctypes.c_int
+        lib.cess_blsmap_xmd_u_indexed.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_uint64, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
+        ]
+        lib.cess_blsmap_xmd_u_indexed.restype = ctypes.c_int
         lib.cess_blsmap_init._configured = True
     return lib
 
@@ -236,3 +249,72 @@ def hash_to_g1_batch(
             (int.from_bytes(chunk[:48], "big"), int.from_bytes(chunk[48:], "big"))
         )
     return res
+
+
+def xmd_u_batch(msgs: list[bytes], dst: bytes, threads: int = 1):
+    """expand_message_xmd + hash_to_field only — the host front half of
+    the DEVICE hash-to-curve path (ops/h2c.py).  Returns
+    (u: np.uint8 (N, 2, 48) canonical big-endian field elements,
+     flags: np.uint8 (N,)) with flag bits
+    (sgn0(u0), sswu_exceptional(u0), sgn0(u1), sswu_exceptional(u1))
+    in bits 0..3 — the predicates the device kernel takes as inputs."""
+    import numpy as np
+
+    blsmap_init()
+    lib = _blsmap_lib()
+    assert all(len(m) <= 1024 for m in msgs), "message too long"
+    assert len(dst) <= 255
+    blob = b"".join(msgs)
+    offs = (ctypes.c_uint64 * (len(msgs) + 1))()
+    acc = 0
+    for i, m in enumerate(msgs):
+        offs[i] = acc
+        acc += len(m)
+    offs[len(msgs)] = acc
+    out_u = ctypes.create_string_buffer(96 * len(msgs))
+    out_f = ctypes.create_string_buffer(len(msgs))
+    rc = lib.cess_blsmap_xmd_u_batch(
+        blob, offs, len(msgs), dst, len(dst), out_u, out_f, threads
+    )
+    if rc != 0:
+        raise RuntimeError(f"xmd_u_batch failed: {rc}")
+    u = np.frombuffer(out_u.raw, dtype=np.uint8).reshape(len(msgs), 2, 48)
+    flags = np.frombuffer(out_f.raw, dtype=np.uint8)
+    return u, flags
+
+
+def xmd_u_indexed(names: list[bytes], name_ids, indices, dst: bytes,
+                  threads: int = 1):
+    """xmd_u_batch for messages of the podr2 chunk-point framing
+    name ‖ '/' ‖ LE64(index), assembled natively: `name_ids` (uint32) and
+    `indices` (uint64) are parallel arrays selecting (names[id], index)
+    per output row — Python never builds the per-pair byte strings."""
+    import numpy as np
+
+    blsmap_init()
+    lib = _blsmap_lib()
+    assert all(len(m) <= 1000 for m in names), "name too long"
+    assert len(dst) <= 255
+    name_ids = np.ascontiguousarray(name_ids, dtype=np.uint32)
+    indices = np.ascontiguousarray(indices, dtype=np.uint64)
+    n = len(name_ids)
+    assert len(indices) == n
+    blob = b"".join(names)
+    offs = (ctypes.c_uint64 * (len(names) + 1))()
+    acc = 0
+    for i, m in enumerate(names):
+        offs[i] = acc
+        acc += len(m)
+    offs[len(names)] = acc
+    out_u = ctypes.create_string_buffer(96 * n)
+    out_f = ctypes.create_string_buffer(max(n, 1))
+    rc = lib.cess_blsmap_xmd_u_indexed(
+        blob, offs, len(names),
+        name_ids.ctypes.data, indices.ctypes.data, n,
+        dst, len(dst), out_u, out_f, threads,
+    )
+    if rc != 0:
+        raise RuntimeError(f"xmd_u_indexed failed: {rc}")
+    u = np.frombuffer(out_u.raw, dtype=np.uint8).reshape(n, 2, 48)
+    flags = np.frombuffer(out_f.raw, dtype=np.uint8)[:n]
+    return u, flags

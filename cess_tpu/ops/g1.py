@@ -264,7 +264,7 @@ def addm(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 def subm(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     override = _TABLE_OVERRIDE.get()
     if override is not None:
-        pad = override["subpad"]
+        pad = override["subpad"].reshape((L,) + (1,) * (a.ndim - 1))
     else:
         pad = jnp.asarray(_sub_pad()).reshape((L,) + (1,) * (a.ndim - 1))
     s = jnp.pad(a + pad - b, [(0, 1)] + [(0, 0)] * (a.ndim - 1))
@@ -333,6 +333,62 @@ def pt_double(p):
 def _select(cond, a, b):
     """cond: (…) bool over the batch shape; a, b: (33, …) limb arrays."""
     return jnp.where(cond[None], a, b)
+
+
+# ------------------------------------------------------------ exact digits
+
+
+def _prefix_or_and(g: jnp.ndarray, p: jnp.ndarray) -> jnp.ndarray:
+    """Inclusive Kogge–Stone scan of carry/borrow propagation along axis
+    0: out_i = g_i | (p_i & (g_{i-1} | (p_{i-1} & …))).  int32 {0,1}."""
+
+    def comb(a, b):
+        ga, pa = a
+        gb, pb = b
+        return gb | (pb & ga), pa & pb
+
+    G, _ = jax.lax.associative_scan(comb, (g, p), axis=0)
+    return G
+
+
+def exact_digits(x: jnp.ndarray, passes: int = 3) -> jnp.ndarray:
+    """Non-negative limb array → EXACT base-4096 digits of the same
+    value (same length; the caller guarantees the value fits).  `passes`
+    value-preserving carry sweeps bound limbs to ≤ 4096 (3 suffices for
+    limbs < 2^28), then one Kogge–Stone scan resolves the remaining
+    unit carries, which can otherwise cascade the full length."""
+    x = _norm(x, passes)
+    tail = [(0, 0)] * (x.ndim - 1)
+    e = x & (BASE - 1)
+    c = x >> LIMB_BITS  # ∈ {0, 1}
+    a = e + jnp.pad(c[:-1], [(1, 0)] + tail)
+    g = (a >= BASE).astype(jnp.int32)
+    p = (a == BASE - 1).astype(jnp.int32)
+    cin = jnp.pad(_prefix_or_and(g, p)[:-1], [(1, 0)] + tail)
+    return (a + cin) & (BASE - 1)
+
+
+def limb_product_digits(a: jnp.ndarray, b: jnp.ndarray,
+                        out_len: int) -> jnp.ndarray:
+    """Exact digits of the integer product of two exact-digit limb
+    values: a (ka, …) × b (kb, …) → (out_len, …).  Used to form wide MSM
+    scalars (e.g. ρ·v·h_eff) on device instead of per-element host
+    big-int work."""
+    if min(a.shape[0], b.shape[0]) > 16:
+        # anti-diagonal sums of min(ka, kb) 4095² products must stay
+        # below 2^28 for exact_digits' three carry passes to be exact
+        raise ValueError("limb_product_digits: operand too wide (>16 limbs)")
+    ka = a.shape[0]
+    tail = [(0, 0)] * (a.ndim - 1)
+    kb = b.shape[0]
+    width = ka + kb  # conv length ka+kb-1, +1 headroom for carries
+    acc = jnp.pad(a[0:1] * b, [(0, width - kb)] + tail)
+    for i in range(1, ka):
+        acc = acc + jnp.pad(a[i : i + 1] * b, [(i, width - kb - i)] + tail)
+    if out_len > width:
+        acc = jnp.pad(acc, [(0, out_len - width)] + tail)
+    digits = exact_digits(acc, passes=3)
+    return digits[:out_len]
 
 
 # ---------------------------------------------------------------- MSM
@@ -603,6 +659,179 @@ def msm_grouped(
     return projective_to_points(
         np.asarray(rX).T[:B], np.asarray(rY).T[:B], np.asarray(rZ).T[:B]
     )
+
+
+# ------------------------------------------------------------ flat MSM
+# Pippenger-style windowed-bucket MSM for ONE large flat sum
+# Σ_i s_i·P_i — the shape of the batch-verification folds at north-star
+# scale.  Cost per point is ~n_windows bucket-contributions (complete
+# adds) instead of the ladder's `bits` double-and-adds: at 352-bit
+# scalars and 12-bit windows that is ~30 adds/point vs ~700.
+#
+# TPU mapping: buckets cannot be scatter-accumulated (point addition is
+# not an arithmetic scatter op), so each window (a) sorts the lanes by
+# digit (lax.sort_key_val), (b) sums runs of equal digits with a
+# SEGMENTED associative scan whose combine is the complete add, (c)
+# scatters the run totals into the bucket array (unique indices), and
+# (d) folds Σ_d d·B_d with the standard suffix-sum identity.  The window
+# width is the limb width (12 bits), so the scalar's exact base-4096
+# digits ARE the bucket indices — no digit extraction.
+#
+# Scalars may be WIDER than r (raw integers): nothing here reduces mod
+# r, which is exactly what the cofactor-folding contract needs
+# (ops/h2c.py — scalars arrive multiplied by h_eff on points whose
+# group order is h·r).
+
+
+def _window_bucket_fold(points, digit, n_buckets: int):
+    """Σ_i digit_i·P_i for one window: digit (N,) int32 in [0, 4096)."""
+    X, Y, Z = points
+    n = X.shape[1]
+    order = jnp.argsort(digit)
+    sd = jnp.take(digit, order)
+    Xs = jnp.take(X, order, axis=1)
+    Ys = jnp.take(Y, order, axis=1)
+    Zs = jnp.take(Z, order, axis=1)
+
+    def comb(a, b):
+        aX, aY, aZ, aid = a
+        bX, bY, bZ, bid = b
+        same = aid[0] == bid[0]
+        sX, sY, sZ = pt_add((aX, aY, aZ), (bX, bY, bZ))
+        return (
+            _select(same, sX, bX),
+            _select(same, sY, bY),
+            _select(same, sZ, bZ),
+            bid,
+        )
+
+    ids = jnp.broadcast_to(sd[None], (1, n))
+    cX, cY, cZ, _ = jax.lax.associative_scan(
+        comb, (Xs, Ys, Zs, ids), axis=1
+    )
+    # run totals live at run ends; scatter them into buckets (the dump
+    # column absorbs non-end lanes and digit 0)
+    nxt = jnp.concatenate([sd[1:], jnp.full((1,), -1, sd.dtype)])
+    is_end = (sd != nxt) & (sd != 0)
+    idx = jnp.where(is_end, sd, n_buckets)
+    bX = jnp.zeros((L, n_buckets + 1), jnp.int32).at[:, idx].set(cX)
+    bY = (
+        jnp.zeros((L, n_buckets + 1), jnp.int32)
+        .at[0]
+        .set(1)
+        .at[:, idx]
+        .set(cY)
+    )
+    bZ = jnp.zeros((L, n_buckets + 1), jnp.int32).at[:, idx].set(cZ)
+    bX, bY, bZ = bX[:, :n_buckets], bY[:, :n_buckets], bZ[:, :n_buckets]
+    # Σ_d d·B_d = Σ_{k≥1} Σ_{d≥k} B_d: reverse inclusive scan (suffix
+    # sums), zero out lane 0, pairwise tree sum.
+    sX, sY, sZ = jax.lax.associative_scan(
+        lambda a, b: pt_add(a, b), (bX, bY, bZ), axis=1, reverse=True
+    )
+    lane0 = jnp.arange(n_buckets) == 0
+    sX = jnp.where(lane0[None], 0, sX)
+    sY = jnp.where(lane0[None], 1, sY)
+    sZ = jnp.where(lane0[None], 0, sZ)
+    return tree_reduce((sX, sY, sZ), n_buckets)
+
+
+@partial(jax.jit, static_argnames=("n_windows",))
+def _msm_flat_kernel(X, Y, Z, digits, n_windows: int):
+    """digits: (≥n_windows, N) EXACT base-4096 scalar digits.  Returns
+    the single MSM total as (33,) limb triples (projective)."""
+    zero = jnp.zeros((L,), jnp.int32)
+    one = zero.at[0].set(1)
+
+    def body(i, acc):
+        j = n_windows - 1 - i
+        for _ in range(LIMB_BITS):
+            acc = pt_double(acc)
+        w = _window_bucket_fold(
+            (X, Y, Z),
+            jax.lax.dynamic_index_in_dim(digits, j, 0, keepdims=False),
+            BASE,
+        )
+        return pt_add(acc, w)
+
+    aX, aY, aZ = jax.lax.fori_loop(
+        0, n_windows, body, (zero, one, zero)
+    )
+    return aX, aY, aZ
+
+
+@partial(jax.jit, static_argnames=("out_len",))
+def _product_digits_kernel(a, b, out_len: int):
+    return limb_product_digits(a, b, out_len)
+
+
+_FLAT_CHUNK = 1 << 20  # lanes per device call: bounds scan memory
+
+
+def msm_flat_device(points, digits, bits: int):
+    """Flat MSM over device-resident limb points with exact-digit device
+    scalars.  points: (X, Y, Z) each (33, N); digits: (K, N) with
+    K ≥ ⌈bits/12⌉.  Chunks the lane axis (window sums are additive
+    across chunks) and returns the projective total as numpy (33,)
+    triples."""
+    X, Y, Z = points
+    n = X.shape[1]
+    n_windows = -(-bits // LIMB_BITS)
+    if digits.shape[0] < n_windows:
+        raise ValueError("digit rows < windows for the requested bits")
+    total = None
+    for start in range(0, n, _FLAT_CHUNK):
+        end = min(start + _FLAT_CHUNK, n)
+        part = _msm_flat_kernel(
+            X[:, start:end],
+            Y[:, start:end],
+            Z[:, start:end],
+            digits[:, start:end],
+            n_windows,
+        )
+        total = part if total is None else _pt_add_single(total, part)
+    return tuple(np.asarray(t) for t in total)
+
+
+@jax.jit
+def _pt_add_single(p, q):
+    return pt_add(p, q)
+
+
+def scalars_to_digits(scalars, n_limbs: int) -> np.ndarray:
+    """Raw integer scalars (possibly ≥ r — flat-MSM semantics never
+    reduce) → (n_limbs, N) exact base-4096 digits."""
+    out = np.zeros((len(scalars), n_limbs), dtype=np.int32)
+    for j, s in enumerate(scalars):
+        s = int(s)
+        if s < 0:
+            raise ValueError("negative scalar")
+        for k in range(n_limbs):
+            out[j, k] = s & (BASE - 1)
+            s >>= LIMB_BITS
+        if s:
+            raise ValueError("scalar exceeds digit width")
+    return out.T
+
+
+def msm_wide(points: list[G1Point], scalars: list[int], bits: int) -> G1Point:
+    """Host-list flat-MSM entry: Σ [s_i]P_i with raw (unreduced) integer
+    scalars up to `bits` wide — the Pippenger path.  Bit-identity with
+    the host fold is asserted in tests/test_msm_flat.py."""
+    if len(points) != len(scalars):
+        raise ValueError("points/scalars length mismatch")
+    if not points:
+        return G1Point.infinity()
+    n_windows = -(-bits // LIMB_BITS)
+    X, Y, Z = points_to_projective(points)
+    d = scalars_to_digits(scalars, n_windows)
+    (X, Y, Z, d), _ = _pad_pow2([X, Y, Z, d.T], len(points))
+    rX, rY, rZ = msm_flat_device(
+        (jnp.asarray(X.T), jnp.asarray(Y.T), jnp.asarray(Z.T)),
+        jnp.asarray(d.T),
+        bits,
+    )
+    return projective_to_points(rX[None], rY[None], rZ[None])[0]
 
 
 @partial(jax.jit, static_argnames=("bits",))

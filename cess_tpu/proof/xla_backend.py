@@ -24,10 +24,11 @@ declared verification seam (c-pallets/audit/src/lib.rs:484).
 
 from __future__ import annotations
 
+import jax
 import numpy as np
 
 from ..ops import bls12_381 as bls
-from ..ops import fr, g1, podr2
+from ..ops import fr, g1, h2c, podr2
 from ..ops.bls12_381 import G1Point, G2Point, R
 from ..ops.podr2 import Challenge, Podr2Params, Podr2Proof
 from .backend import ProofBackend, ProveRequest, VerifyItem
@@ -40,6 +41,15 @@ _PROVE_CHUNK = 1024
 # batch weights ρ are 128-bit by construction (podr2.batch_rho).
 _COEFF_BITS = 160
 _RHO_BITS = 128
+# Coefficients arrive at the MSM multiplied by the effective cofactor
+# (ops/h2c.py cofactor-folding contract): 160 + 64 bits.
+_COEFF_HEFF_BITS = _COEFF_BITS + 64
+
+# Below this many (proof, chunk) pairs the host native hash-to-curve
+# (native/blsmap.cpp, ~0.6 ms/pair) beats paying a device map compile +
+# padded launch; above it the device SSWU path (ops/h2c.py) wins and
+# scales.  Verdicts are bit-identical either way (tests/test_h2c.py).
+_DEVICE_H2C_MIN_PAIRS = 256
 
 
 class XlaBackend(ProofBackend):
@@ -51,8 +61,14 @@ class XlaBackend(ProofBackend):
 
     name = "xla"
 
-    def __init__(self, mesh=None) -> None:
+    def __init__(self, mesh=None, device_h2c: bool | None = None) -> None:
         self.mesh = mesh
+        # device_h2c: None = auto (device SSWU only on a real TPU, where
+        # the fused Pallas map wins); True/False force it — tests force
+        # True to exercise the wiring on the CPU mesh.  On CPU the
+        # emulated-limb map is slower than the native host hash, so auto
+        # keeps CPU-only hosts on the native path at every batch size.
+        self.device_h2c = device_h2c
         # H-point memo for one verify_batch call: the bisection tree
         # re-visits identical (name, index) pairs across overlapping
         # subsets; hash each pair once (the cached-chunk_point role of
@@ -67,6 +83,73 @@ class XlaBackend(ProofBackend):
         return [self._h_memo[p] for p in pairs]
 
     # ------------------------------------------------------------ verify
+
+    def _h_inner_fold_device(self, items: list[VerifyItem]) -> list[G1Point]:
+        """Per-item Π_c H(name‖i_c)^{v_c}, entirely on device: host XMD →
+        device SSWU map (uncleared points) → grouped MSM with v_c·h_eff
+        scalars ([v·h_eff]Q = [v]([h_eff]Q), so the result is the cleared
+        fold — tests/test_h2c.py TestCofactorFolding)."""
+        import jax.numpy as jnp
+
+        B = len(items)
+        names = [name for name, _, _ in items]
+        # zip-truncation semantics, exactly like the host reference's
+        # `zip(coefficients(), indices)` (ops/podr2.py _rhs_point /
+        # batch_verify): a challenge with mismatched index/random list
+        # lengths contributes min(len) pairs on every backend.
+        counts = [
+            min(len(ch.indices), len(ch.randoms)) for _, ch, _ in items
+        ]
+        name_ids = np.repeat(np.arange(B, dtype=np.uint32), counts)
+        indices = np.concatenate(
+            [
+                np.asarray(ch.indices[:c], dtype=np.uint64)
+                for (_, ch, _), c in zip(items, counts)
+            ]
+        )
+        (X, Y, Z), n = h2c.hash_pairs_device(
+            names, name_ids, indices, podr2.H_DST
+        )
+
+        # grouped layout: pad each item's chunk row to a power-of-two
+        # width, and the item count to a power of two (dead lanes get
+        # scalar 0, which the ladder turns into an ∞ contribution
+        # regardless of the gathered point).
+        g = 1 << max(0, (max(counts) - 1).bit_length())
+        Bp = 1 << max(0, (B - 1).bit_length())
+        lane_map = np.zeros((Bp, g), dtype=np.int32)
+        slimbs = np.zeros((Bp, g, g1.R_LIMBS), dtype=np.int32)
+        limb_cache: dict[int, np.ndarray] = {}
+
+        def limbs_of(v: int) -> np.ndarray:
+            row = limb_cache.get(v)
+            if row is None:
+                row = np.asarray(
+                    [(v >> (12 * k)) & 4095 for k in range(g1.R_LIMBS)],
+                    dtype=np.int32,
+                )
+                limb_cache[v] = row
+            return row
+
+        pos = 0
+        for b, ((_, ch, _), cnt) in enumerate(zip(items, counts)):
+            coeffs = ch.coefficients()[:cnt]
+            for k, v in enumerate(coeffs):
+                lane_map[b, k] = pos + k
+                slimbs[b, k] = limbs_of(v * h2c.H_EFF)
+            pos += cnt
+
+        flat = lane_map.reshape(-1)
+        Xg = jnp.take(X, jnp.asarray(flat), axis=1)
+        Yg = jnp.take(Y, jnp.asarray(flat), axis=1)
+        Zg = jnp.take(Z, jnp.asarray(flat), axis=1)
+        s = jnp.asarray(slimbs.reshape(Bp * g, g1.R_LIMBS).T)
+        rX, rY, rZ = g1._msm_kernel(
+            Xg, Yg, Zg, s, bits=_COEFF_HEFF_BITS, group=g
+        )
+        return g1.projective_to_points(
+            np.asarray(rX).T[:B], np.asarray(rY).T[:B], np.asarray(rZ).T[:B]
+        )
 
     def _combined_check(
         self,
@@ -123,19 +206,40 @@ class XlaBackend(ProofBackend):
         lhs = g1.msm(sigmas, rhos, bits=_RHO_BITS)
 
         # H-side: per-item Π_c H^{v_c} (grouped MSM over the challenged
-        # chunk points, hashed through the native batch kernel), then the
-        # ρ fold across items.
-        flat_pairs = [
-            (name, i) for name, ch, _ in items for i in ch.indices
-        ]
-        flat_pts = self._chunk_points(flat_pairs)
-        h_pts = []
-        pos = 0
-        for _, ch, _ in items:
-            h_pts.append(flat_pts[pos : pos + len(ch.indices)])
-            pos += len(ch.indices)
-        h_coeffs = [list(ch.coefficients()) for _, ch, _ in items]
-        inner = g1.msm_grouped(h_pts, h_coeffs, bits=_COEFF_BITS)
+        # chunk points), then the ρ fold across items.  At batch scale
+        # the random-oracle points are hashed ON DEVICE (ops/h2c.py:
+        # host XMD → device SSWU) and stay device-resident into the MSM,
+        # with the effective cofactor folded into the coefficients.
+        n_pairs = sum(len(ch.indices) for _, ch, _ in items)
+        use_device = (
+            self.device_h2c
+            if self.device_h2c is not None
+            else jax.default_backend() == "tpu"
+        )
+        if use_device and n_pairs >= _DEVICE_H2C_MIN_PAIRS:
+            inner = self._h_inner_fold_device(items)
+        else:
+            # same zip-truncation semantics as the host reference and
+            # the device branch above
+            counts = [
+                min(len(ch.indices), len(ch.randoms)) for _, ch, _ in items
+            ]
+            flat_pairs = [
+                (name, i)
+                for (name, ch, _), c in zip(items, counts)
+                for i in ch.indices[:c]
+            ]
+            flat_pts = self._chunk_points(flat_pairs)
+            h_pts = []
+            pos = 0
+            for c in counts:
+                h_pts.append(flat_pts[pos : pos + c])
+                pos += c
+            h_coeffs = [
+                list(ch.coefficients()[:c])
+                for (_, ch, _), c in zip(items, counts)
+            ]
+            inner = g1.msm_grouped(h_pts, h_coeffs, bits=_COEFF_BITS)
         rhs = g1.msm(inner, rhos, bits=_RHO_BITS)
 
         # u-side: Π_j u_j^{e_j} over the global sector generators.

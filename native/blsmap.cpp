@@ -163,16 +163,26 @@ static bool eq(const Fp& a, const Fp& b) {
   return true;
 }
 
-static void bytes_be_to_fp(const uint8_t* in, size_t len, Fp& out) {
-  // big-endian bytes (any length) reduced mod p via shift-add
-  Fp acc = {{0}};
-  for (size_t i = 0; i < len; ++i) {
-    // acc = acc * 256 + in[i] (mod p)
-    for (int k = 0; k < 8; ++k) add_mod(acc, acc, acc);
-    Fp b = {{in[i], 0, 0, 0, 0, 0}};
-    add_mod(acc, b, acc);
+// 64 big-endian bytes → canonical PLAIN-domain Fp (hash_to_field's hot
+// shape): u = hi·2^384 + lo, and mont_mul(hi, R2) = hi·R²·R⁻¹ = hi·R =
+// hi·2^384 mod p directly in the plain domain — one Montgomery multiply,
+// no round-trips.  Shared by the full hash path and the device-offload
+// front half so the parsing/reduction can never diverge.
+static void bytes_be64_to_fp_plain(const uint8_t* in, Fp& out_plain) {
+  Fp hi = {{0}}, lo, t;
+  uint64_t h1 = 0, h0 = 0;
+  for (int k = 0; k < 8; ++k) h1 = (h1 << 8) | in[k];
+  for (int k = 8; k < 16; ++k) h0 = (h0 << 8) | in[k];
+  hi.v[0] = h0;
+  hi.v[1] = h1;
+  for (int i = 0; i < NL; ++i) {
+    uint64_t limb = 0;
+    for (int k = 0; k < 8; ++k) limb = (limb << 8) | in[16 + 40 - 8 * i + k];
+    lo.v[i] = limb;
   }
-  out = acc;
+  while (geq(lo, P)) sub_nocheck(lo, P);  // lo < 2^384 < 10p: ≤ 10 rounds
+  mont_mul(hi, R2, t);  // = hi·2^384 mod p, plain domain
+  add_mod(t, lo, out_plain);
 }
 
 static void fp_to_bytes_be(const Fp& a, uint8_t out[48]) {
@@ -188,6 +198,8 @@ static void fp_to_bytes_be(const Fp& a, uint8_t out[48]) {
 static Fp A_M, B_M, Z_M;       // E' SSWU parameters (Montgomery)
 static Fp NEG_B_OVER_A;        // -B/A
 static Fp B_OVER_ZA;           // B/(Z*A)
+static Fp EXC_CMP;             // (-1/Z)·R^{-1}: u is SSWU-exceptional iff
+                               // mont_mul(u,u) == EXC_CMP (both sides /R)
 static Fp FOUR_M;              // E: y^2 = x^3 + 4
 static uint64_t H_EFF;         // effective cofactor (64-bit)
 static std::vector<Fp> XNUM, XDEN, YNUM, YDEN;  // isogeny (Montgomery)
@@ -474,6 +486,26 @@ static void expand_xmd(const uint8_t* msg, size_t msg_len, const uint8_t* dst,
   }
 }
 
+// 128 uniform bytes → two canonical big-endian u values + predicate
+// flags, with two mont_muls per element: mont_mul(hi, R2) computes
+// hi·2^384 mod p directly in the plain domain, and the exceptional test
+// compares mont_mul(u, u) = u²·R^{-1} against the precomputed
+// (-1/Z)·R^{-1} (tv2 = Z²u⁴ + Zu² ≡ 0 ⟺ u = 0 or u² = −1/Z).
+static uint8_t u_pair_from_uniform(const uint8_t uniform[128],
+                                   uint8_t out_u[96]) {
+  uint8_t flags = 0;
+  for (int e = 0; e < 2; ++e) {
+    Fp u, usq;
+    bytes_be64_to_fp_plain(uniform + 64 * e, u);
+    fp_to_bytes_be(u, out_u + 48 * e);
+    if (u.v[0] & 1) flags |= (uint8_t)(1u << (2 * e));
+    mont_mul(u, u, usq);  // = u²·R^{-1}
+    if (eq(usq, EXC_CMP) || is_zero(u))
+      flags |= (uint8_t)(1u << (2 * e + 1));
+  }
+  return flags;
+}
+
 static void hash_one(const uint8_t* msg, size_t msg_len, const uint8_t* dst,
                      size_t dst_len, uint8_t out[96]) {
   uint8_t uniform[128];
@@ -484,9 +516,9 @@ static void hash_one(const uint8_t* msg, size_t msg_len, const uint8_t* dst,
   acc.y = ONE_M;
   for (int e = 0; e < 2; ++e) {
     Fp u, um;
-    bytes_be_to_fp(uniform + 64 * e, 64, u);
-    int up = (int)(u.v[0] & 1);
+    bytes_be64_to_fp_plain(uniform + 64 * e, u);
     to_mont(u, um);
+    int up = (int)(u.v[0] & 1);
     Fp sx, sy, ex, ey;
     sswu_map(um, up, sx, sy);
     if (!iso_map(sx, sy, ex, ey)) continue;  // point at infinity: skip add
@@ -567,6 +599,15 @@ CESS_EXPORT int cess_blsmap_init(
   mont_mul(Z_M, A_M, za);
   mont_inv(za, zainv);
   mont_mul(B_M, zainv, B_OVER_ZA);
+  {
+    // EXC_CMP = (-1/Z)·R^{-1}: from_mont twice takes Z^{-1}·R down to
+    // Z^{-1}·R^{-1}, then negate mod p.
+    Fp zinv, t, zero = {{0}};
+    mont_inv(Z_M, zinv);        // Z^{-1}·R
+    from_mont(zinv, t);         // Z^{-1}
+    from_mont(t, t);            // Z^{-1}·R^{-1}
+    sub_mod(zero, t, EXC_CMP);  // −Z^{-1}·R^{-1}
+  }
 
   auto load_vec = [&](const uint8_t* b, uint64_t n, std::vector<Fp>& out) {
     out.resize(n);
@@ -577,6 +618,97 @@ CESS_EXPORT int cess_blsmap_init(
   load_vec(ynum, n_ynum, YNUM);
   load_vec(yden, n_yden, YDEN);
   INITED = true;
+  return 0;
+}
+
+// Device-offload front half: expand_message_xmd + hash_to_field only.
+// The TPU runs the SSWU map itself (cess_tpu/ops/h2c.py); the host
+// supplies, per message, the two reduced field elements u0, u1
+// (canonical big-endian 48 B each) plus the predicate bits the device
+// kernel cannot derive from loose limbs without a canonical pass it
+// would rather skip:
+//   bit0: sgn0(u0)   bit1: sswu-exceptional(u0)  [Z²u⁴ + Zu² ≡ 0]
+//   bit2: sgn0(u1)   bit3: sswu-exceptional(u1)
+CESS_EXPORT int cess_blsmap_xmd_u_batch(
+    const uint8_t* msgs, const uint64_t* offsets, uint64_t n,
+    const uint8_t* dst, uint64_t dst_len, uint8_t* out_u,
+    uint8_t* out_flags, uint64_t n_threads) {
+  using namespace blsmap;
+  if (!INITED) return 1;
+  if (dst_len > 255) return 2;
+  for (uint64_t i = 0; i < n; ++i) {
+    if (offsets[i + 1] - offsets[i] > 1024) return 3;  // xmd buffer bound
+  }
+  auto work = [&](uint64_t lo, uint64_t hi) {
+    for (uint64_t i = lo; i < hi; ++i) {
+      const uint8_t* msg = msgs + offsets[i];
+      size_t len = (size_t)(offsets[i + 1] - offsets[i]);
+      uint8_t uniform[128];
+      expand_xmd(msg, len, dst, dst_len, uniform);
+      out_flags[i] = u_pair_from_uniform(uniform, out_u + 96 * i);
+    }
+  };
+  if (n_threads <= 1 || n < 2 * n_threads) {
+    work(0, n);
+    return 0;
+  }
+  std::vector<std::thread> pool;
+  uint64_t chunk = (n + n_threads - 1) / n_threads;
+  for (uint64_t t = 0; t < n_threads; ++t) {
+    uint64_t lo = t * chunk;
+    uint64_t hi = lo + chunk < n ? lo + chunk : n;
+    if (lo >= hi) break;
+    pool.emplace_back(work, lo, hi);
+  }
+  for (auto& th : pool) th.join();
+  return 0;
+}
+
+// Indexed variant: messages are name ‖ '/' ‖ LE64(index) — the podr2
+// chunk-point framing (cess_tpu/ops/podr2.py chunk_point) — assembled
+// here so Python never materialises millions of byte strings.
+CESS_EXPORT int cess_blsmap_xmd_u_indexed(
+    const uint8_t* names, const uint64_t* name_offsets, uint64_t n_names,
+    const uint32_t* name_ids, const uint64_t* indices, uint64_t n,
+    const uint8_t* dst, uint64_t dst_len, uint8_t* out_u,
+    uint8_t* out_flags, uint64_t n_threads) {
+  using namespace blsmap;
+  if (!INITED) return 1;
+  if (dst_len > 255) return 2;
+  for (uint64_t k = 0; k < n_names; ++k) {
+    if (name_offsets[k + 1] - name_offsets[k] > 1000) return 3;
+  }
+  for (uint64_t i = 0; i < n; ++i) {
+    if (name_ids[i] >= n_names) return 4;
+  }
+  auto work = [&](uint64_t lo, uint64_t hi) {
+    uint8_t msg[1024 + 9];
+    for (uint64_t i = lo; i < hi; ++i) {
+      const uint64_t k = name_ids[i];
+      const size_t nlen =
+          (size_t)(name_offsets[k + 1] - name_offsets[k]);
+      std::memcpy(msg, names + name_offsets[k], nlen);
+      msg[nlen] = '/';
+      uint64_t idx = indices[i];
+      for (int b = 0; b < 8; ++b) msg[nlen + 1 + b] = (uint8_t)(idx >> (8 * b));
+      uint8_t uniform[128];
+      expand_xmd(msg, nlen + 9, dst, dst_len, uniform);
+      out_flags[i] = u_pair_from_uniform(uniform, out_u + 96 * i);
+    }
+  };
+  if (n_threads <= 1 || n < 2 * n_threads) {
+    work(0, n);
+    return 0;
+  }
+  std::vector<std::thread> pool;
+  uint64_t chunk = (n + n_threads - 1) / n_threads;
+  for (uint64_t t = 0; t < n_threads; ++t) {
+    uint64_t lo = t * chunk;
+    uint64_t hi = lo + chunk < n ? lo + chunk : n;
+    if (lo >= hi) break;
+    pool.emplace_back(work, lo, hi);
+  }
+  for (auto& th : pool) th.join();
   return 0;
 }
 

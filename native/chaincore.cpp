@@ -19,6 +19,13 @@
 #include <cstring>
 #include <vector>
 
+#if (defined(__x86_64__) || defined(_M_X64)) && \
+    (defined(__GNUC__) || defined(__clang__))
+// target attributes + __builtin_cpu_supports are GCC/clang-only
+#define CESS_HAVE_X86_SHA 1
+#include <immintrin.h>
+#endif
+
 #if defined(_WIN32)
 #define CESS_EXPORT extern "C" __declspec(dllexport)
 #else
@@ -127,11 +134,69 @@ static void sha256_compress(uint32_t h[8], const uint8_t block[64]) {
   h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
 }
 
+#if defined(CESS_HAVE_X86_SHA)
+// SHA-NI compress: same function, hardware rounds.  Dispatched at runtime
+// (__builtin_cpu_supports) so the .so stays portable; bit-identity with
+// the portable compressor is covered by the cess_sha256-vs-hashlib tests.
+// Round constants come from the same derived kSha table — nothing new is
+// transcribed here.
+__attribute__((target("sha,sse4.1")))
+static void sha256_compress_ni(uint32_t h[8], const uint8_t block[64]) {
+  const __m128i SHUF =
+      _mm_set_epi64x(0x0c0d0e0f08090a0bULL, 0x0405060700010203ULL);
+  __m128i T = _mm_shuffle_epi32(_mm_loadu_si128((const __m128i*)&h[0]), 0xB1);
+  __m128i S1 = _mm_shuffle_epi32(_mm_loadu_si128((const __m128i*)&h[4]), 0x1B);
+  __m128i S0 = _mm_alignr_epi8(T, S1, 8);   // ABEF
+  S1 = _mm_blend_epi16(S1, T, 0xF0);        // CDGH
+  const __m128i A0 = S0, A1 = S1;
+
+  __m128i M[4];
+  for (int i = 0; i < 4; i++)
+    M[i] = _mm_shuffle_epi8(
+        _mm_loadu_si128((const __m128i*)(block + 16 * i)), SHUF);
+
+  for (int r = 0; r < 16; r++) {
+    __m128i msg = _mm_add_epi32(
+        M[r & 3], _mm_loadu_si128((const __m128i*)&kSha.K[4 * r]));
+    S1 = _mm_sha256rnds2_epu32(S1, S0, msg);
+    S0 = _mm_sha256rnds2_epu32(S0, S1, _mm_shuffle_epi32(msg, 0x0E));
+    if (r < 12) {
+      // schedule W[16+4r .. 19+4r] from the rolling 4-group window
+      __m128i m = _mm_sha256msg1_epu32(M[r & 3], M[(r + 1) & 3]);
+      m = _mm_add_epi32(
+          m, _mm_alignr_epi8(M[(r + 3) & 3], M[(r + 2) & 3], 4));
+      M[r & 3] = _mm_sha256msg2_epu32(m, M[(r + 3) & 3]);
+    }
+  }
+  S0 = _mm_add_epi32(S0, A0);
+  S1 = _mm_add_epi32(S1, A1);
+  T = _mm_shuffle_epi32(S0, 0x1B);          // FEBA
+  S1 = _mm_shuffle_epi32(S1, 0xB1);         // DCHG
+  S0 = _mm_blend_epi16(T, S1, 0xF0);        // DCBA
+  S1 = _mm_alignr_epi8(S1, T, 8);           // HGFE
+  _mm_storeu_si128((__m128i*)&h[0], S0);
+  _mm_storeu_si128((__m128i*)&h[4], S1);
+}
+
+static bool sha_ni_available() {
+  return __builtin_cpu_supports("sha") && __builtin_cpu_supports("sse4.1");
+}
+#else  // !CESS_HAVE_X86_SHA
+static bool sha_ni_available() { return false; }
+static void sha256_compress_ni(uint32_t h[8], const uint8_t block[64]) {
+  sha256_compress(h, block);
+}
+#endif
+
+typedef void (*Sha256CompressFn)(uint32_t[8], const uint8_t[64]);
+static const Sha256CompressFn kSha256Compress =
+    sha_ni_available() ? sha256_compress_ni : sha256_compress;
+
 static void sha256(const uint8_t* data, size_t len, uint8_t out[32]) {
   uint32_t h[8];
   memcpy(h, kSha.H0, sizeof(h));
   size_t full = len / 64;
-  for (size_t i = 0; i < full; i++) sha256_compress(h, data + 64 * i);
+  for (size_t i = 0; i < full; i++) kSha256Compress(h, data + 64 * i);
   uint8_t tail[128] = {0};
   size_t rem = len - full * 64;
   memcpy(tail, data + full * 64, rem);
@@ -140,7 +205,7 @@ static void sha256(const uint8_t* data, size_t len, uint8_t out[32]) {
   uint64_t bitlen = (uint64_t)len * 8;
   for (int i = 0; i < 8; i++)
     tail[tail_len - 1 - i] = (uint8_t)(bitlen >> (8 * i));
-  for (size_t i = 0; i < tail_len; i += 64) sha256_compress(h, tail + i);
+  for (size_t i = 0; i < tail_len; i += 64) kSha256Compress(h, tail + i);
   for (int i = 0; i < 8; i++) {
     out[4 * i] = (uint8_t)(h[i] >> 24);
     out[4 * i + 1] = (uint8_t)(h[i] >> 16);

@@ -1,0 +1,174 @@
+"""Device SSWU hash-to-curve (ops/h2c.py) — bit-identity vs the host
+reference (ops/bls12_381.py hash_to_g1 / map_to_curve_g1), including the
+cofactor-folding contract the verify path relies on."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from cess_tpu.ops import bls12_381 as bls  # noqa: E402
+from cess_tpu.ops import g1, h2c  # noqa: E402
+
+DST = b"cess/podr2/h/v1"
+P = bls.P
+
+
+def _enc(vals):
+    out = np.zeros((33, len(vals)), np.int32)
+    for j, v in enumerate(vals):
+        for k in range(32):
+            out[k, j] = (v >> (12 * k)) & 4095
+    return out
+
+
+def _dec(a, j):
+    return sum(int(a[k, j]) << (12 * k) for k in range(33)) % P
+
+
+class TestCanonical:
+    def test_canon_mod_p_exact(self):
+        rng = np.random.default_rng(0)
+        limbs = rng.integers(0, 4097, size=(33, 8), dtype=np.int32)
+        vals = [
+            sum(int(limbs[i, j]) << (12 * i) for i in range(33))
+            for j in range(8)
+        ]
+        digits = np.asarray(h2c._canon_mod_p(jnp.asarray(limbs)))
+        for j, v in enumerate(vals):
+            if v >= (1 << 384) + 8192 * P:
+                continue  # outside the loose contract
+            got = sum(int(digits[i, j]) << (12 * i) for i in range(33))
+            assert got == v % P
+            assert got < P
+
+    def test_u_codec_roundtrip(self):
+        rng = np.random.default_rng(1)
+        u = rng.integers(0, 256, size=(5, 2, 48), dtype=np.uint8)
+        lb = h2c.u_bytes_to_limbs(u)
+        for i in range(5):
+            for e in range(2):
+                want = int.from_bytes(u[i, e].tobytes(), "big")
+                got = sum(int(lb[k, i, e]) << (12 * k) for k in range(33))
+                assert got == want
+
+
+class TestMapBitIdentity:
+    def test_pairs_match_host_hash_to_g1(self):
+        names = [b"h2c-%d" % i for i in range(4)]
+        ids = np.repeat(np.arange(4, dtype=np.uint32), 2)
+        idxs = np.tile(np.array([3, 99], dtype=np.uint64), 4)
+        pts = h2c.hash_pairs_host_points(names, ids, idxs, DST)
+        for p, (k, idx) in zip(pts, zip(ids, idxs)):
+            msg = names[int(k)] + b"/" + int(idx).to_bytes(8, "little")
+            want = bls.hash_to_g1(msg, DST)
+            assert (p.x, p.y) == (want.x, want.y)
+
+    def test_edge_u_values(self):
+        """u ∈ {0, 1, p−1, sqrt(−1/Z) if any} through the raw kernel vs
+        the host map — covers the SSWU-exceptional CMOV and both sqrt
+        branches at the extremes."""
+        cand = [0, 1, P - 1, 2, P - 2, 5, 7, 11]
+        neg_inv_z = -pow(h2c.Z_SSWU, P - 2, P) % P
+        r = bls.fp_sqrt(neg_inv_z)
+        if r is not None:
+            cand.extend([r, P - r])
+        us = list(cand[:8])  # keep the lane count a power of two
+        n = len(us) // 2
+        u = np.zeros((33, 2, n), np.int32)
+        sgn = np.zeros((2, n), np.int32)
+        exc = np.zeros((2, n), np.int32)
+        for j in range(n):
+            for e in range(2):
+                uu = us[2 * j + e]
+                u[:, e, j] = _enc([uu])[:, 0]
+                sgn[e, j] = uu & 1
+                exc[e, j] = int(uu == 0 or uu * uu % P == neg_inv_z)
+        X, Y, Z = h2c._map_pairs_kernel(
+            jnp.asarray(u), jnp.asarray(sgn), jnp.asarray(exc)
+        )
+        X, Y, Z = (np.asarray(a) for a in (X, Y, Z))
+        for j in range(n):
+            want = bls.map_to_curve_g1(us[2 * j]) + bls.map_to_curve_g1(
+                us[2 * j + 1]
+            )
+            z = _dec(Z, j)
+            if want.is_infinity():
+                assert z == 0
+                continue
+            zi = pow(z, P - 2, P)
+            got = (_dec(X, j) * zi % P, _dec(Y, j) * zi % P)
+            assert got == (want.x, want.y), us[2 * j : 2 * j + 2]
+
+
+@pytest.mark.slow
+class TestDeviceHashVerifyPath:
+    def test_backend_verdicts_identical_through_device_hash(self):
+        """verify_batch above the device-h2c threshold (≥256 pairs):
+        verdicts — including a corrupted proof found by bisection — are
+        identical to CpuBackend."""
+        import random
+
+        from cess_tpu.ops import podr2
+        from cess_tpu.ops.podr2 import Challenge, Podr2Params
+        from cess_tpu.proof import CpuBackend, XlaBackend
+
+        params = Podr2Params(n=64, s=4)
+        sk, pk = podr2.keygen(b"itest")
+        rnd = random.Random(5)
+        indices = tuple(sorted(rnd.sample(range(params.n), 47)))
+        ch = Challenge(
+            indices=indices,
+            randoms=tuple(rnd.randbytes(20) for _ in indices),
+        )
+        items = []
+        for i in range(8):
+            nm = b"itest-frag-%d" % i
+            data = rnd.randbytes(params.fragment_bytes)
+            tags = podr2.tag_fragment(sk, nm, data, params)
+            items.append((nm, ch, podr2.prove(tags, data, ch, params)))
+        bad = items[3]
+        mu = list(bad[2].mu)
+        mu[0] = (mu[0] + 1) % podr2.R
+        items[3] = (bad[0], bad[1], podr2.Podr2Proof(bad[2].sigma, mu))
+
+        vx = XlaBackend(device_h2c=True).verify_batch(
+            pk, items, b"seed", params
+        )
+        vc = CpuBackend().verify_batch(pk, items, b"seed", params)
+        want = [True] * 8
+        want[3] = False
+        assert vx == vc == want
+
+
+class TestCofactorFolding:
+    def test_msm_with_heff_scalars_matches_cleared_fold(self):
+        """MSM over UNCLEARED device points with scalars s·h_eff equals
+        the host fold Π hash_to_g1(m)^s — the exact contract the xla
+        backend's H-side uses."""
+        names = [b"fold-%d" % i for i in range(2)]
+        ids = np.repeat(np.arange(2, dtype=np.uint32), 4)
+        idxs = np.tile(np.arange(4, dtype=np.uint64), 2)
+        scalars = [3, 1 << 120, 12345678901234567890, 1, 2, 7, (1 << 160) - 1, 9]
+
+        (X, Y, Z), n = h2c.hash_pairs_device(names, ids, idxs, DST)
+        assert n == 8
+        slimbs = np.zeros((len(scalars), 22), np.int32)
+        for j, s in enumerate(scalars):
+            v = s * h2c.H_EFF
+            for k in range(22):
+                slimbs[j, k] = (v >> (12 * k)) & 4095
+        rX, rY, rZ = g1._msm_kernel(
+            X, Y, Z, jnp.asarray(slimbs.T), bits=224
+        )
+        got = g1.projective_to_points(
+            np.asarray(rX).T, np.asarray(rY).T, np.asarray(rZ).T
+        )[0]
+        want = bls.G1Point.infinity()
+        for (k, idx), s in zip(zip(ids, idxs), scalars):
+            msg = names[int(k)] + b"/" + int(idx).to_bytes(8, "little")
+            want = want + bls.hash_to_g1(msg, DST).mul(s)
+        assert (got.x, got.y, got.is_infinity()) == (
+            want.x, want.y, want.is_infinity(),
+        )

@@ -1,0 +1,82 @@
+"""Flat Pippenger MSM (ops/g1.py msm_flat/msm_wide) and its exact-digit
+scalar machinery — the wide-scalar bucket path the north-star folds use."""
+
+import random
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from cess_tpu.ops import bls12_381 as bls  # noqa: E402
+from cess_tpu.ops import g1  # noqa: E402
+from cess_tpu.ops.bls12_381 import G1Point, R  # noqa: E402
+
+
+class TestExactDigits:
+    def test_exact_digits_random(self):
+        rng = np.random.default_rng(0)
+        x = rng.integers(0, 1 << 27, size=(20, 6), dtype=np.int32)
+        x[-2:] = 0  # the value must FIT the digit width (caller contract)
+        d = np.asarray(g1.exact_digits(jnp.asarray(x)))
+        assert d.min() >= 0 and d.max() < 4096
+        for j in range(6):
+            want = sum(int(x[i, j]) << (12 * i) for i in range(20))
+            got = sum(int(d[i, j]) << (12 * i) for i in range(20))
+            assert got == want
+
+    def test_limb_product_digits(self):
+        rng = random.Random(1)
+        a_vals = [rng.randrange(0, 1 << 128) for _ in range(4)]
+        b_vals = [rng.randrange(0, 1 << 160) for _ in range(4)]
+        a = np.asarray(
+            [[(v >> (12 * k)) & 4095 for v in a_vals] for k in range(11)],
+            dtype=np.int32,
+        )
+        b = np.asarray(
+            [[(v >> (12 * k)) & 4095 for v in b_vals] for k in range(14)],
+            dtype=np.int32,
+        )
+        d = np.asarray(
+            g1.limb_product_digits(jnp.asarray(a), jnp.asarray(b), 25)
+        )
+        for j in range(4):
+            want = a_vals[j] * b_vals[j]
+            got = sum(int(d[i, j]) << (12 * i) for i in range(25))
+            assert got == want
+
+    def test_limb_product_width_guard(self):
+        a = jnp.zeros((17, 2), jnp.int32)
+        with pytest.raises(ValueError):
+            g1.limb_product_digits(a, a, 40)
+
+    def test_scalars_to_digits_roundtrip(self):
+        vals = [0, 1, R, (1 << 352) - 1, 12345678901234567890]
+        d = g1.scalars_to_digits(vals, 30)
+        for j, v in enumerate(vals):
+            got = sum(int(d[i, j]) << (12 * i) for i in range(30))
+            assert got == v
+        with pytest.raises(ValueError):
+            g1.scalars_to_digits([1 << 360], 30)
+
+
+@pytest.mark.slow
+class TestMsmWide:
+    def test_flat_msm_matches_host_fold_raw_wide_scalars(self):
+        """Σ [s_i]P_i through the windowed-bucket kernel equals the host
+        fold, for raw 352-bit scalars (≥ r: nothing may reduce mod r —
+        the cofactor-folding contract) plus 0/1/r edge scalars."""
+        rnd = random.Random(42)
+        G = bls.G1_GENERATOR
+        pts = [G.mul(rnd.randrange(1, R)) for _ in range(16)]
+        scalars = [rnd.randrange(0, 1 << 352) for _ in range(12)] + [
+            0, 1, R, (1 << 352) - 1,
+        ]
+        got = g1.msm_wide(pts, scalars, bits=352)
+        want = G1Point.infinity()
+        for p, s in zip(pts, scalars):
+            want = want + p._mul_raw(s)
+        assert (got.x, got.y, got.is_infinity()) == (
+            want.x, want.y, want.is_infinity(),
+        )
