@@ -1,0 +1,7 @@
+"""`python -m cess_tpu` → the node CLI (cess_tpu/node/cli.py)."""
+
+import sys
+
+from .node.cli import main
+
+sys.exit(main())

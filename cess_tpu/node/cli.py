@@ -1,0 +1,176 @@
+"""CLI — `python -m cess_tpu <command>` (L6).
+
+Role match: the reference's CLI (reference: node/src/cli.rs:1-70,
+command.rs:55-90 — run, build-spec, export-state, import-blocks,
+purge-chain) mapped onto this framework's service:
+
+  run           start a node (chain spec, RPC port, optional block cap)
+  build-spec    print a preset chain spec as JSON
+  export-state  write the chain state checkpoint blob
+  import-state  start from a checkpoint and print the state hash
+  rpc           one-shot JSON-RPC call against a running node
+  metrics       fetch a node's Prometheus metrics
+  bench         run the repo bench (north-star measurement)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _cmd_run(args) -> int:
+    from .chain_spec import load_spec
+    from .rpc import RpcServer
+    from .service import NodeService
+
+    spec = load_spec(args.chain)
+    if args.block_time_ms:
+        spec.block_time_ms = args.block_time_ms
+    service = NodeService(spec, authority=args.authority)
+    if args.import_state:
+        with open(args.import_state, "rb") as fh:
+            service.import_state(fh.read())
+    server = RpcServer(service, host=args.rpc_host, port=args.rpc_port)
+    server.start()
+    print(
+        f"cess-tpu-node: chain={spec.chain_id} rpc={server.host}:{server.port}"
+        f" block_time={spec.block_time_ms}ms",
+        flush=True,
+    )
+    service.start()
+    try:
+        if args.blocks:
+            while service.rt.state.block_number < args.blocks:
+                time.sleep(0.05)
+        else:
+            while True:
+                time.sleep(1)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        service.stop()
+        server.stop()
+    print(
+        f"stopped at block {service.rt.state.block_number} "
+        f"state={service.state_hash()[:16]}…",
+        flush=True,
+    )
+    return 0
+
+
+def _cmd_build_spec(args) -> int:
+    from .chain_spec import load_spec
+
+    print(load_spec(args.chain).to_json())
+    return 0
+
+
+def _cmd_export_state(args) -> int:
+    from .chain_spec import load_spec
+    from .service import NodeService
+
+    service = NodeService(load_spec(args.chain))
+    for _ in range(args.blocks):
+        service.produce_block()
+    blob = service.export_state()
+    with open(args.output, "wb") as fh:
+        fh.write(blob)
+    print(f"exported {len(blob)} bytes at block "
+          f"{service.rt.state.block_number}; state={service.state_hash()}")
+    return 0
+
+
+def _cmd_import_state(args) -> int:
+    from .chain_spec import load_spec
+    from .service import NodeService
+
+    service = NodeService(load_spec(args.chain))
+    with open(args.input, "rb") as fh:
+        service.import_state(fh.read())
+    print(f"imported: block={service.rt.state.block_number} "
+          f"state={service.state_hash()}")
+    return 0
+
+
+def _cmd_rpc(args) -> int:
+    from .rpc import rpc_call
+
+    params = [json.loads(p) for p in args.params]
+    result = rpc_call(args.host, args.port, args.method, params)
+    print(json.dumps(result, indent=2, sort_keys=True))
+    return 0
+
+
+def _cmd_metrics(args) -> int:
+    from .rpc import rpc_call
+
+    sys.stdout.write(rpc_call(args.host, args.port, "system_metrics"))
+    return 0
+
+
+def _cmd_bench(_args) -> int:
+    import runpy
+
+    runpy.run_path("bench.py", run_name="__main__")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="cess_tpu", description="CESS-TPU node CLI"
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+
+    run = sub.add_parser("run", help="run a node")
+    run.add_argument("--chain", default="dev",
+                     help="preset (dev/local) or spec JSON path")
+    run.add_argument("--rpc-host", default="127.0.0.1")
+    run.add_argument("--rpc-port", type=int, default=9944)
+    run.add_argument("--authority", default=None,
+                     help="author only this validator's slots")
+    run.add_argument("--blocks", type=int, default=0,
+                     help="stop after N blocks (0 = run forever)")
+    run.add_argument("--block-time-ms", type=int, default=0)
+    run.add_argument("--import-state", default=None,
+                     help="checkpoint blob to resume from")
+    run.set_defaults(fn=_cmd_run)
+
+    bs = sub.add_parser("build-spec", help="print a chain spec")
+    bs.add_argument("--chain", default="dev")
+    bs.set_defaults(fn=_cmd_build_spec)
+
+    ex = sub.add_parser("export-state", help="checkpoint the chain state")
+    ex.add_argument("--chain", default="dev")
+    ex.add_argument("--blocks", type=int, default=10)
+    ex.add_argument("output")
+    ex.set_defaults(fn=_cmd_export_state)
+
+    im = sub.add_parser("import-state", help="restore from a checkpoint")
+    im.add_argument("--chain", default="dev")
+    im.add_argument("input")
+    im.set_defaults(fn=_cmd_import_state)
+
+    rpc = sub.add_parser("rpc", help="one-shot RPC call")
+    rpc.add_argument("--host", default="127.0.0.1")
+    rpc.add_argument("--port", type=int, default=9944)
+    rpc.add_argument("method")
+    rpc.add_argument("params", nargs="*",
+                     help="JSON-encoded positional params")
+    rpc.set_defaults(fn=_cmd_rpc)
+
+    met = sub.add_parser("metrics", help="fetch node metrics")
+    met.add_argument("--host", default="127.0.0.1")
+    met.add_argument("--port", type=int, default=9944)
+    met.set_defaults(fn=_cmd_metrics)
+
+    be = sub.add_parser("bench", help="run the north-star bench")
+    be.set_defaults(fn=_cmd_bench)
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)

@@ -1,0 +1,141 @@
+"""Prometheus-style metrics registry (SURVEY §5 tracing/observability).
+
+The reference threads a prometheus registry through its service
+(reference: node/src/service.rs:151,185,309,376,529 — pool, import
+queue, RPC and telemetry all report into it).  This is the equivalent
+seam: counters/gauges/histograms registered here are rendered in the
+text exposition format by the RPC server's `system_metrics` method and
+the CLI's `metrics` command."""
+
+from __future__ import annotations
+
+import threading
+import time
+from bisect import bisect_right
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, registry: "Registry | None"):
+        self.name = name
+        self.help = help_
+        self._lock = threading.Lock()
+        (registry if registry is not None else REGISTRY).register(self)
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name, help_="", registry=None):
+        super().__init__(name, help_, registry)
+        self.value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self.value += amount
+
+    def samples(self):
+        return [(self.name, "", self.value)]
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name, help_="", registry=None):
+        super().__init__(name, help_, registry)
+        self.value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self.value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self.value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    def samples(self):
+        return [(self.name, "", self.value)]
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+    DEFAULT_BUCKETS = (
+        0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 60.0,
+    )
+
+    def __init__(self, name, help_="", buckets=None, registry=None):
+        super().__init__(name, help_, registry)
+        self.buckets = tuple(sorted(buckets or self.DEFAULT_BUCKETS))
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.total = 0.0
+        self.n = 0
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self.counts[bisect_right(self.buckets, value)] += 1
+            self.total += value
+            self.n += 1
+
+    def time(self):
+        """Context manager observing elapsed seconds."""
+        metric = self
+
+        class _Timer:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                metric.observe(time.perf_counter() - self.t0)
+                return False
+
+        return _Timer()
+
+    def samples(self):
+        out = []
+        acc = 0
+        for b, c in zip(self.buckets, self.counts):
+            acc += c
+            out.append((self.name + "_bucket", f'le="{b}"', acc))
+        out.append((self.name + "_bucket", 'le="+Inf"', self.n))
+        out.append((self.name + "_sum", "", self.total))
+        out.append((self.name + "_count", "", self.n))
+        return out
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._metrics: dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def register(self, metric: _Metric) -> None:
+        with self._lock:
+            if metric.name in self._metrics:
+                raise ValueError(f"duplicate metric {metric.name}")
+            self._metrics[metric.name] = metric
+
+    def get(self, name: str):
+        return self._metrics.get(name)
+
+    def render(self) -> str:
+        """Prometheus text exposition format."""
+        lines = []
+        for m in self._metrics.values():
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            for name, labels, value in m.samples():
+                label_s = "{" + labels + "}" if labels else ""
+                v = int(value) if float(value).is_integer() else value
+                lines.append(f"{name}{label_s} {v}")
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = Registry()
+
+
+def scoped_registry() -> Registry:
+    """Fresh registry for tests / multiple in-process services."""
+    return Registry()

@@ -1,0 +1,279 @@
+"""JSON-RPC server over TCP — the node's wire surface.
+
+Role match: the reference's RPC stack (reference: node/src/rpc.rs:148-328
+— System, Babe/RRSC, TransactionPayment, eth endpoints) reduced to the
+capabilities this framework exposes: system info/health/metrics, chain
+and state queries, extrinsic submission, and the CESS pallet views
+(miner info, challenge snapshot, file metadata, TEE registry).
+
+Framing: newline-delimited JSON-RPC 2.0 objects over a plain TCP
+socket — one request per line, one response per line, connections are
+persistent.  `python -m cess_tpu rpc <method> [params…]` is the CLI
+client; node.client.RpcClient the programmatic one."""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import threading
+from typing import Any, Callable
+
+from .service import Extrinsic, NodeService
+
+
+class RpcError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+
+
+def _view(obj: Any) -> Any:
+    """State value → JSON-safe view (dataclasses, bytes, sets, maps)."""
+    import dataclasses
+
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {
+            f.name: _view(getattr(obj, f.name))
+            for f in dataclasses.fields(obj)
+        }
+    if isinstance(obj, bytes):
+        return {"hex": obj.hex()}
+    if isinstance(obj, (list, tuple)):
+        return [_view(x) for x in obj]
+    if isinstance(obj, (set, frozenset)):
+        return sorted(_view(x) for x in obj)
+    if isinstance(obj, dict):
+        return {str(k): _view(v) for k, v in obj.items()}
+    return obj
+
+
+class RpcApi:
+    """Method registry bound to one NodeService."""
+
+    def __init__(self, service: NodeService):
+        self.service = service
+        self.methods: dict[str, Callable] = {}
+        s = service
+
+        def method(name):
+            def deco(fn):
+                self.methods[name] = fn
+                return fn
+            return deco
+
+        # ---- system (rpc.rs System role)
+        @method("system_name")
+        def _name():
+            return "cess-tpu-node"
+
+        @method("system_chain")
+        def _chain():
+            return s.spec.name
+
+        @method("system_health")
+        def _health():
+            return {
+                "peers": 0, "isSyncing": False,
+                "shouldHavePeers": len(s.spec.validators) > 1,
+                "txpool": len(s.pool),
+            }
+
+        @method("system_metrics")
+        def _metrics():
+            return s.registry.render()
+
+        @method("system_chainGenesis")
+        def _genesis():
+            return s.genesis
+
+        # ---- chain
+        @method("chain_getHeader")
+        def _head():
+            n = s.rt.state.block_number
+            return {"number": n, "author": s.blocks[-1].author if s.blocks else None}
+
+        @method("chain_getBlock")
+        def _block(number: int):
+            for b in s.blocks:
+                if b.number == number:
+                    return {
+                        "number": b.number, "author": b.author,
+                        "extrinsics": b.extrinsics, "receipts": b.receipts,
+                    }
+            raise RpcError(-32004, "block not found")
+
+        @method("state_getStateHash")
+        def _shash():
+            return s.state_hash()
+
+        @method("state_getEvents")
+        def _events(last: int = 20):
+            return _view(list(s.rt.state.events)[-int(last):])
+
+        # ---- author
+        @method("author_submitExtrinsic")
+        def _submit(ext: dict):
+            try:
+                return s.submit_extrinsic(Extrinsic.from_json(ext))
+            except (ValueError, KeyError) as e:
+                raise RpcError(-32010, str(e))
+
+        @method("author_pendingExtrinsics")
+        def _pending():
+            return len(s.pool)
+
+        @method("author_nonce")
+        def _nonce(account: str):
+            return s.nonces.get(account, 0)
+
+        # ---- cess pallet views (rpc.rs custom-API role)
+        @method("balances_free")
+        def _free(account: str):
+            return s.rt.state.balances.free(account)
+
+        @method("sminer_minerInfo")
+        def _miner(account: str):
+            info = s.rt.sminer.miner_items.get(account)
+            if info is None:
+                raise RpcError(-32004, "miner not found")
+            return _view(info)
+
+        @method("sminer_allMiners")
+        def _miners():
+            return s.rt.sminer.get_all_miner()
+
+        @method("audit_challengeSnapshot")
+        def _chal():
+            return _view(s.rt.audit.challenge_snap_shot)
+
+        @method("fileBank_fileInfo")
+        def _file(file_hash: str):
+            f = s.rt.file_bank.file.get(file_hash)
+            if f is None:
+                raise RpcError(-32004, "file not found")
+            return _view(f)
+
+        @method("storage_userOwnedSpace")
+        def _space(account: str):
+            return _view(s.rt.storage_handler.user_owned_space.get(account))
+
+        @method("teeWorker_podr2Key")
+        def _podr2():
+            pk = s.rt.tee_worker.tee_podr2_pk
+            return None if pk is None else {"hex": pk.hex()}
+
+        @method("teeWorker_controllers")
+        def _tees():
+            return s.rt.tee_worker.get_controller_list()
+
+        @method("staking_validators")
+        def _vals():
+            return _view(s.rt.staking.validators)
+
+        # ---- dev helpers
+        @method("dev_produceBlock")
+        def _produce():
+            rec = s.produce_block()
+            return None if rec is None else {
+                "number": rec.number, "receipts": rec.receipts,
+            }
+
+    def handle(self, request: dict) -> dict:
+        rid = request.get("id")
+        name = request.get("method", "")
+        params = request.get("params", [])
+        fn = self.methods.get(name)
+        if fn is None:
+            return {
+                "jsonrpc": "2.0", "id": rid,
+                "error": {"code": -32601, "message": f"no method {name}"},
+            }
+        try:
+            result = fn(*params) if isinstance(params, list) else fn(**params)
+            return {"jsonrpc": "2.0", "id": rid, "result": result}
+        except RpcError as e:
+            return {
+                "jsonrpc": "2.0", "id": rid,
+                "error": {"code": e.code, "message": str(e)},
+            }
+        except Exception as e:  # surface, don't kill the connection
+            return {
+                "jsonrpc": "2.0", "id": rid,
+                "error": {"code": -32603, "message": f"{type(e).__name__}: {e}"},
+            }
+
+
+class RpcServer:
+    """Threaded newline-JSON TCP server (the rpc_builder role,
+    service.rs:319-354)."""
+
+    def __init__(self, service: NodeService, host: str = "127.0.0.1",
+                 port: int = 0):
+        api = RpcApi(service)
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                for line in self.rfile:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        req = json.loads(line)
+                    except json.JSONDecodeError:
+                        resp = {
+                            "jsonrpc": "2.0", "id": None,
+                            "error": {"code": -32700, "message": "parse error"},
+                        }
+                    else:
+                        resp = api.handle(req)
+                    self.wfile.write(
+                        json.dumps(resp, separators=(",", ":")).encode()
+                        + b"\n"
+                    )
+                    self.wfile.flush()
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self.api = api
+        self._server = Server((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+def rpc_call(host: str, port: int, method: str, params: list | None = None,
+             timeout: float = 30.0):
+    """One-shot client call (shared by the CLI and tests)."""
+    with socket.create_connection((host, port), timeout=timeout) as sock:
+        sock.sendall(
+            json.dumps(
+                {"jsonrpc": "2.0", "id": 1, "method": method,
+                 "params": params or []},
+                separators=(",", ":"),
+            ).encode() + b"\n"
+        )
+        buf = b""
+        while not buf.endswith(b"\n"):
+            chunk = sock.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+    resp = json.loads(buf)
+    if "error" in resp:
+        raise RpcError(resp["error"]["code"], resp["error"]["message"])
+    return resp["result"]

@@ -1,0 +1,376 @@
+"""Node service: signed-extrinsic pool → slot-driven block production.
+
+Role match: the reference's service assembly (reference:
+node/src/service.rs:219-584 — tx pool, import queue, RRSC authoring
+loop) collapsed onto the deterministic Runtime: extrinsics are
+BLS-signed, nonce-ordered, verified at intake (the pool's validation
+role), and applied in block order after on_initialize, with per-block
+receipts as the event record.  The RRSC stand-in (chain/rrsc.py) picks
+the slot author; a service configured with an authority key only authors
+its own slots — several NodeService processes over the same spec stay
+in lockstep the way replicated state machines do."""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+from ..chain.runtime import Runtime
+from ..chain.types import DispatchError
+from ..chain import checkpoint
+from ..ops import bls12_381 as bls
+from .chain_spec import ChainSpec
+from . import metrics as m
+
+
+# ------------------------------------------------------------ extrinsic
+
+
+@dataclass
+class Extrinsic:
+    """Signed call: the reference's UncheckedExtrinsic role.  args are
+    JSON values; byte arguments travel as {"hex": "..."}."""
+
+    signer: str
+    module: str
+    call: str
+    args: list
+    nonce: int
+    signature: str = ""  # hex BLS signature over payload()
+
+    def payload(self, genesis: str) -> bytes:
+        return json.dumps(
+            [genesis, self.signer, self.module, self.call, self.args,
+             self.nonce],
+            sort_keys=True, separators=(",", ":"),
+        ).encode()
+
+    def sign(self, sk: int, genesis: str) -> "Extrinsic":
+        self.signature = bls.sign(sk, self.payload(genesis)).hex()
+        return self
+
+    def hash(self, genesis: str) -> str:
+        return hashlib.blake2b(
+            self.payload(genesis) + bytes.fromhex(self.signature),
+            digest_size=32,
+        ).hexdigest()
+
+    def to_json(self) -> dict:
+        return {
+            "signer": self.signer, "module": self.module, "call": self.call,
+            "args": self.args, "nonce": self.nonce, "sig": self.signature,
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "Extrinsic":
+        return cls(
+            signer=d["signer"], module=d["module"], call=d["call"],
+            args=list(d["args"]), nonce=int(d["nonce"]),
+            signature=d.get("sig", ""),
+        )
+
+
+def _decode_arg(v):
+    if isinstance(v, dict) and set(v) == {"hex"}:
+        return bytes.fromhex(v["hex"])
+    if isinstance(v, list):
+        return [_decode_arg(x) for x in v]
+    return v
+
+
+def _b(v) -> bytes:
+    """JSON arg → bytes ({"hex": …} or plain hex string)."""
+    if isinstance(v, dict):
+        return bytes.fromhex(v["hex"])
+    return bytes.fromhex(v)
+
+
+def _adapt_tee_register(rt, sender, args):
+    from ..chain.tee_worker import SgxAttestationReport
+    from ..utils.hashing import Hash64  # noqa: F401 (coercion set below)
+
+    stash, node_key, peer, pbk, att = args
+    rt.tee_worker.register(
+        sender, stash, _b(node_key), _b(peer), _b(pbk),
+        SgxAttestationReport(
+            report_json_raw=_b(att["report"]),
+            sign=_b(att["sign"]),
+            cert_der=_b(att["cert"]),
+        ),
+    )
+
+
+def _adapt_upload_declaration(rt, sender, args):
+    from ..chain.file_bank import SegmentList, UserBrief
+    from ..utils.hashing import Hash64
+
+    file_hash, deal_info, brief, size = args
+    segs = [
+        SegmentList(
+            hash=Hash64(s["hash"]),
+            fragment_list=[Hash64(h) for h in s["fragments"]],
+        )
+        for s in deal_info
+    ]
+    ub = UserBrief(
+        user=brief["user"], file_name=brief["fileName"],
+        bucket_name=brief["bucket"],
+    )
+    rt.file_bank.upload_declaration(sender, Hash64(file_hash), segs, ub,
+                                    int(size))
+
+
+def _adapt_upload_filler(rt, sender, args):
+    from ..chain.file_bank import FillerInfo
+    from ..utils.hashing import Hash64
+
+    tee, fillers = args
+    infos = [FillerInfo(filler_hash=Hash64(f)) for f in fillers]
+    rt.file_bank.upload_filler(sender, tee, infos)
+
+
+# Callable extrinsics: (module, call) → adapter (None = generic
+# sender-first dispatch with JSON args).  Matches the pallets' origin
+# argument (reference: each #[pallet::call]); root-only and
+# scheduler-only calls (calculate_end, deal_reassign_miner,
+# update_whitelist, the unsigned quorum intake) are absent by design.
+EXTRINSIC_DISPATCH: dict = {
+    **{("sminer", c): None for c in (
+        "regnstk", "increase_collateral", "update_beneficiary",
+        "update_peer_id", "receive_reward", "faucet_top_up", "faucet",
+        "withdraw",
+    )},
+    **{("storage_handler", c): None for c in (
+        "buy_space", "expansion_space", "renewal_space",
+    )},
+    **{("oss", c): None for c in (
+        "authorize", "cancel_authorize", "register", "update", "destroy",
+    )},
+    **{("cacher", c): None for c in ("logout",)},
+    **{("staking", c): None for c in (
+        "bond", "bond_extra", "unbond", "withdraw_unbonded", "validate",
+        "nominate", "chill",
+    )},
+    ("tee_worker", "exit"): None,
+    ("tee_worker", "register"): _adapt_tee_register,
+    **{("file_bank", c): None for c in (
+        "transfer_report", "replace_file_report", "delete_file",
+        "create_bucket", "delete_bucket", "generate_restoral_order",
+        "claim_restoral_order", "restoral_order_complete",
+        "miner_exit_prep",
+    )},
+    ("file_bank", "upload_declaration"): _adapt_upload_declaration,
+    ("file_bank", "upload_filler"): _adapt_upload_filler,
+    **{("audit", c): None for c in (
+        "submit_proof", "submit_verify_result",
+    )},
+}
+
+
+# ------------------------------------------------------------ tx pool
+
+
+class TxPool:
+    """FIFO pool with per-account nonce gating (BasicPool's ready/future
+    split, reference: node/src/service.rs:148-154)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._ready: deque[Extrinsic] = deque()
+        self._seen: set[str] = set()
+
+    def submit(self, ext: Extrinsic, genesis: str) -> str:
+        h = ext.hash(genesis)
+        with self._lock:
+            if h in self._seen:
+                raise ValueError("duplicate extrinsic")
+            self._seen.add(h)
+            self._ready.append(ext)
+        return h
+
+    def drain(self, limit: int) -> list[Extrinsic]:
+        with self._lock:
+            out = []
+            while self._ready and len(out) < limit:
+                out.append(self._ready.popleft())
+            return out
+
+    def __len__(self) -> int:
+        return len(self._ready)
+
+
+# ------------------------------------------------------------ service
+
+
+@dataclass
+class BlockRecord:
+    number: int
+    author: str
+    extrinsics: list[str] = field(default_factory=list)
+    receipts: list[dict] = field(default_factory=list)
+
+
+class NodeService:
+    """One chain node: Runtime + pool + block authoring + state export.
+
+    authority: the validator name this node authors for (None = author
+    every slot — the single-node dev mode)."""
+
+    MAX_EXTRINSICS_PER_BLOCK = 512
+
+    def __init__(
+        self,
+        spec: ChainSpec,
+        authority: str | None = None,
+        ias_roots=None,
+        registry: "m.Registry | None" = None,
+    ) -> None:
+        self.spec = spec
+        self.authority = authority
+        if ias_roots is None and spec.dev_seed:
+            # dev/local chains pin the deterministic fixture authority so
+            # TEE registration (and client-minted attestations) work out
+            # of the box
+            from ..proof import ias
+            from .chain_spec import dev_ias_authority
+
+            root_der, _ = dev_ias_authority(spec.chain_id)
+            ias_roots = ias.RootStore.from_der([root_der])
+        self.rt = Runtime(spec.runtime_config(ias_roots=ias_roots))
+        self.keys = spec.public_keys()
+        self.genesis = hashlib.blake2b(
+            spec.to_json().encode(), digest_size=32
+        ).hexdigest()
+        self.pool = TxPool()
+        self.nonces: dict[str, int] = {}
+        self.blocks: list[BlockRecord] = []
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+        reg = registry if registry is not None else m.REGISTRY
+        self.m_blocks = m.Counter(
+            "cess_blocks_produced", "blocks authored by this node", reg)
+        self.m_ext_ok = m.Counter(
+            "cess_extrinsics_applied", "successful extrinsics", reg)
+        self.m_ext_err = m.Counter(
+            "cess_extrinsics_failed", "dispatch errors", reg)
+        self.m_pool = m.Gauge("cess_txpool_ready", "pool depth", reg)
+        self.m_block_time = m.Histogram(
+            "cess_block_seconds", "block production time", registry=reg)
+        self.registry = reg
+
+    # ------------------------------------------------------ submission
+
+    def submit_extrinsic(self, ext: Extrinsic) -> str:
+        """Pool intake: signature + nonce + whitelist validation (the
+        validate_transaction role)."""
+        if (ext.module, ext.call) not in EXTRINSIC_DISPATCH:
+            raise ValueError(f"unknown call {ext.module}::{ext.call}")
+        pk = self.keys.get(ext.signer)
+        if pk is None:
+            raise ValueError(f"unknown signer {ext.signer}")
+        if not bls.verify(pk, ext.payload(self.genesis),
+                          bytes.fromhex(ext.signature)):
+            raise ValueError("bad signature")
+        expected = self.nonces.get(ext.signer, 0)
+        if ext.nonce != expected:
+            raise ValueError(f"bad nonce: expected {expected}")
+        self.nonces[ext.signer] = expected + 1
+        h = self.pool.submit(ext, self.genesis)
+        self.m_pool.set(len(self.pool))
+        return h
+
+    # ------------------------------------------------------ authoring
+
+    def _slot_author(self) -> str:
+        rrsc = getattr(self.rt, "rrsc", None)
+        if rrsc is not None:
+            try:
+                author = rrsc.slot_author(self.rt.state.block_number + 1)
+                if author is not None:
+                    return author
+            except Exception:
+                pass
+        return self.spec.validators[0] if self.spec.validators else "dev"
+
+    def produce_block(self) -> BlockRecord | None:
+        """One slot: on_initialize hooks, then apply pooled extrinsics.
+        Returns None when this node is not the slot author."""
+        with self._lock, self.m_block_time.time():
+            author = self._slot_author()
+            if self.authority is not None and author != self.authority:
+                return None
+            self.rt.run_blocks(1)
+            record = BlockRecord(number=self.rt.state.block_number, author=author)
+            for ext in self.pool.drain(self.MAX_EXTRINSICS_PER_BLOCK):
+                adapter = EXTRINSIC_DISPATCH.get((ext.module, ext.call))
+                receipt = {"hash": ext.hash(self.genesis), "ok": True}
+                try:
+                    if adapter is not None:
+                        adapter(self.rt, ext.signer, ext.args)
+                    else:
+                        pallet = getattr(self.rt, ext.module)
+                        fn = getattr(pallet, ext.call)
+                        fn(ext.signer, *[_decode_arg(a) for a in ext.args])
+                    self.m_ext_ok.inc()
+                except DispatchError as e:
+                    receipt = {**receipt, "ok": False, "error": str(e)}
+                    self.m_ext_err.inc()
+                except (TypeError, ValueError) as e:
+                    receipt = {
+                        **receipt, "ok": False,
+                        "error": f"invalid-call: {e}",
+                    }
+                    self.m_ext_err.inc()
+                record.extrinsics.append(receipt["hash"])
+                record.receipts.append(receipt)
+            self.blocks.append(record)
+            self.m_blocks.inc()
+            self.m_pool.set(len(self.pool))
+            return record
+
+    # ------------------------------------------------------ slot loop
+
+    def start(self) -> None:
+        """Background authoring at the spec's block time (the
+        start_rrsc loop role, service.rs:459-505)."""
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            period = self.spec.block_time_ms / 1000.0
+            while not self._stop.is_set():
+                t0 = time.monotonic()
+                self.produce_block()
+                dt = time.monotonic() - t0
+                self._stop.wait(max(0.0, period - dt))
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # ------------------------------------------------------ state io
+
+    def export_state(self) -> bytes:
+        """Checkpoint blob (ExportState role, node/src/cli.rs:48-66)."""
+        with self._lock:
+            return checkpoint.snapshot(self.rt)
+
+    def import_state(self, blob: bytes) -> None:
+        with self._lock:
+            checkpoint.restore(self.rt, blob)
+
+    def state_hash(self) -> str:
+        with self._lock:
+            return checkpoint.state_hash(self.rt)

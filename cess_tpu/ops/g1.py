@@ -760,11 +760,6 @@ def _msm_flat_kernel(X, Y, Z, digits, n_windows: int):
     return aX, aY, aZ
 
 
-@partial(jax.jit, static_argnames=("out_len",))
-def _product_digits_kernel(a, b, out_len: int):
-    return limb_product_digits(a, b, out_len)
-
-
 _FLAT_CHUNK = 1 << 20  # lanes per device call: bounds scan memory
 
 

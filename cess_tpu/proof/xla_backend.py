@@ -124,10 +124,7 @@ class XlaBackend(ProofBackend):
         def limbs_of(v: int) -> np.ndarray:
             row = limb_cache.get(v)
             if row is None:
-                row = np.asarray(
-                    [(v >> (12 * k)) & 4095 for k in range(g1.R_LIMBS)],
-                    dtype=np.int32,
-                )
+                row = g1.scalars_to_digits([v], g1.R_LIMBS)[:, 0]
                 limb_cache[v] = row
             return row
 

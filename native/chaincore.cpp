@@ -179,6 +179,9 @@ static void sha256_compress_ni(uint32_t h[8], const uint8_t block[64]) {
 }
 
 static bool sha_ni_available() {
+  // called from a static initializer: cross-DSO ctor ordering does not
+  // guarantee libgcc's cpu-model ctor ran first, so init explicitly
+  __builtin_cpu_init();
   return __builtin_cpu_supports("sha") && __builtin_cpu_supports("sse4.1");
 }
 #else  // !CESS_HAVE_X86_SHA

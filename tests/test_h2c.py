@@ -54,6 +54,23 @@ class TestCanonical:
                 assert got == want
 
 
+class TestNativeXmd:
+    def test_xmd_u_batch_matches_host_hash_to_field(self):
+        pytest.importorskip("cess_tpu.native")
+        from cess_tpu import native
+
+        if native.load() is None:
+            pytest.skip("native library not built")
+        msgs = [b"xmd-%d" % i for i in range(6)]
+        u, flags = native.xmd_u_batch(msgs, DST)
+        for i, msg in enumerate(msgs):
+            u0, u1 = bls.hash_to_field_fp(msg, DST, 2)
+            assert int.from_bytes(u[i, 0].tobytes(), "big") == u0
+            assert int.from_bytes(u[i, 1].tobytes(), "big") == u1
+            assert (flags[i] & 1) == (u0 & 1)
+            assert ((flags[i] >> 2) & 1) == (u1 & 1)
+
+
 class TestMapBitIdentity:
     def test_pairs_match_host_hash_to_g1(self):
         names = [b"h2c-%d" % i for i in range(4)]
