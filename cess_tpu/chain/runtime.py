@@ -50,6 +50,11 @@ class RuntimeConfig:
     podr2_chunk_count: int = 1024               # CHUNK_COUNT (common lib.rs:62)
     genesis_randomness: bytes = bytes(32)
     endowed: dict = field(default_factory=dict)  # account -> free balance
+    # Genesis authority set: bonded + seated at block 0 (the chain-spec
+    # session-keys/staking genesis role, node/src/chain_spec.rs:84-318),
+    # so rrsc.slot_author rotates over them from the first slot.
+    genesis_validators: list = field(default_factory=list)
+    genesis_validator_stake: Balance = 10_000 * TOKEN
     # Pinned attestation trust anchors (proof/ias.RootStore).  None skips
     # the attestation gate (unit-test pallets in isolation); the node sim
     # always pins a root (reference pins Intel's at
@@ -112,6 +117,18 @@ class Runtime:
 
         for acc, amount in cfg.endowed.items():
             self.state.balances.mint(acc, amount)
+
+        # Seat the genesis authorities: top up to the genesis stake if the
+        # endowment doesn't cover it (genesis injection, not a transfer),
+        # bond stash=controller, and seat directly (add_validator keeps
+        # them in place until real candidacies elect a replacement set).
+        for v in cfg.genesis_validators:
+            stake = cfg.genesis_validator_stake
+            free = self.state.balances.free(v)
+            if free < stake:
+                self.state.balances.mint(v, stake - free)
+            self.staking.bond(v, v, stake)
+            self.staking.add_validator(v)
 
         # Root-dispatchable scheduler agenda targets.
         self._dispatch = {

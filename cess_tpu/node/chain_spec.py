@@ -106,6 +106,7 @@ class ChainSpec:
                 for acc, info in self.accounts.items()
             },
             ias_roots=ias_roots,
+            genesis_validators=list(self.validators),
         )
         for k, v in self.genesis.items():
             setattr(cfg, k, v)

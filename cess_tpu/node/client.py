@@ -68,10 +68,7 @@ class SigningClient(RpcClient):
         super().__init__(**kw)
         self.account = account
         self.sk = sk if sk is not None else dev_sk(account, chain_id)
-        # the node's genesis hash binds signatures to this chain; derive
-        # it the same way the service does (spec json digest) — fetched
-        # indirectly by trial: ask the node to reject a bad-genesis sig?
-        # No: expose it via system_chainGenesis.
+        # the node's genesis hash binds signatures to this chain
         self.genesis = self.call("system_chainGenesis")
 
     def submit(self, module: str, call: str, *args) -> str:

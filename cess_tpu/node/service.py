@@ -6,9 +6,11 @@ loop) collapsed onto the deterministic Runtime: extrinsics are
 BLS-signed, nonce-ordered, verified at intake (the pool's validation
 role), and applied in block order after on_initialize, with per-block
 receipts as the event record.  The RRSC stand-in (chain/rrsc.py) picks
-the slot author; a service configured with an authority key only authors
-its own slots — several NodeService processes over the same spec stay
-in lockstep the way replicated state machines do."""
+the slot author from a monotone slot counter; a service configured with
+an authority key authors only its own slots and skips the rest (block
+import/gossip for the skipped slots is out of scope — multi-validator
+chains need every validator's extrinsics submitted to every node, the
+replicated-state-machine discipline, not a network sync)."""
 
 from __future__ import annotations
 
@@ -248,11 +250,14 @@ class NodeService:
         self.pool = TxPool()
         self.nonces: dict[str, int] = {}
         self.blocks: list[BlockRecord] = []
+        self.slot = 0
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
-        reg = registry if registry is not None else m.REGISTRY
+        # Per-service registry by default: two services in one process
+        # must not collide on metric names in the global REGISTRY.
+        reg = registry if registry is not None else m.Registry()
         self.m_blocks = m.Counter(
             "cess_blocks_produced", "blocks authored by this node", reg)
         self.m_ext_ok = m.Counter(
@@ -277,21 +282,24 @@ class NodeService:
         if not bls.verify(pk, ext.payload(self.genesis),
                           bytes.fromhex(ext.signature)):
             raise ValueError("bad signature")
-        expected = self.nonces.get(ext.signer, 0)
-        if ext.nonce != expected:
-            raise ValueError(f"bad nonce: expected {expected}")
-        self.nonces[ext.signer] = expected + 1
-        h = self.pool.submit(ext, self.genesis)
+        # nonce check-and-increment under the service lock: concurrent
+        # RPC threads must not both pass with the same nonce
+        with self._lock:
+            expected = self.nonces.get(ext.signer, 0)
+            if ext.nonce != expected:
+                raise ValueError(f"bad nonce: expected {expected}")
+            self.nonces[ext.signer] = expected + 1
+            h = self.pool.submit(ext, self.genesis)
         self.m_pool.set(len(self.pool))
         return h
 
     # ------------------------------------------------------ authoring
 
-    def _slot_author(self) -> str:
+    def _slot_author(self, slot: int) -> str:
         rrsc = getattr(self.rt, "rrsc", None)
         if rrsc is not None:
             try:
-                author = rrsc.slot_author(self.rt.state.block_number + 1)
+                author = rrsc.slot_author(slot)
                 if author is not None:
                     return author
             except Exception:
@@ -300,9 +308,13 @@ class NodeService:
 
     def produce_block(self) -> BlockRecord | None:
         """One slot: on_initialize hooks, then apply pooled extrinsics.
-        Returns None when this node is not the slot author."""
+        Returns None when this node is not the slot author.  The slot
+        counter advances on EVERY call (authored or not), so an authority
+        node keeps reaching its own slots even while other validators own
+        the intervening ones."""
         with self._lock, self.m_block_time.time():
-            author = self._slot_author()
+            self.slot += 1
+            author = self._slot_author(self.slot)
             if self.authority is not None and author != self.authority:
                 return None
             self.rt.run_blocks(1)
@@ -321,10 +333,14 @@ class NodeService:
                 except DispatchError as e:
                     receipt = {**receipt, "ok": False, "error": str(e)}
                     self.m_ext_err.inc()
-                except (TypeError, ValueError) as e:
+                except (TypeError, ValueError, KeyError, IndexError,
+                        AttributeError) as e:
+                    # malformed argument shapes (missing dict keys, wrong
+                    # arity, bad hex…) must not kill the authoring loop —
+                    # the extrinsic fails, the block goes on
                     receipt = {
                         **receipt, "ok": False,
-                        "error": f"invalid-call: {e}",
+                        "error": f"invalid-call: {e!r}",
                     }
                     self.m_ext_err.inc()
                 record.extrinsics.append(receipt["hash"])

@@ -1,0 +1,169 @@
+"""Aggregate / batched BLS signature verification (BASELINE config 4).
+
+The reference verifies miner/TEE BLS signatures one at a time
+(utils/verify-bls-signatures/src/lib.rs:85-100: one 2-pairing check per
+signature).  At audit scale — thousands of miners submitting signed
+verdicts per round — that is 2N Miller loops.  This module re-expresses
+the workload TPU-first:
+
+ * **Small-exponent batch test.**  Draw Fiat–Shamir weights r_i (128-bit,
+   nonzero, bound to the full (pk, msg, sig) transcript) and check
+
+       e(Π_i sig_i^{r_i}, −g2) · Π_{K} e(Π_{i: pk_i=K} H(m_i)^{r_i}, K) == 1
+
+   which holds iff every per-signature equation holds, except with
+   probability ≤ 2^-128 over the weights (the prover cannot pick
+   cancelling deviations because r depends on the submitted signatures —
+   same argument as ops/podr2.py batch_transcript).
+
+ * **Device G1 folds.**  Both the signature-side fold Π sig_i^{r_i}
+   (one flat Pippenger MSM, ops/g1.py) and the per-key message folds
+   Π H(m_i)^{r_i} (grouped MSM) run on TPU; this is where the group
+   exponentiations — the O(N) 255-bit work — live.
+
+ * **Pairing collapse by key.**  Pairings (host-side, O(1) each) shrink
+   from 2N to 1 + #distinct-keys.  In the protocol the dominant batches
+   are signed under few keys (the network-wide TeePodr2Pk,
+   c-pallets/tee-worker/src/lib.rs:120-121, and per-TEE controller
+   keys), so the pairing count is effectively constant.
+
+`verify_signatures` recovers the per-signature verdict bitmap by
+bisection when a batch fails, mirroring the ProofBackend contract
+(cess_tpu/proof/backend.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from . import bls12_381 as bls
+from . import g1
+from .bls12_381 import G1Point, G2Point
+
+AGG_DST = b"CESS_TPU_BLS_AGG_V1"
+_RHO_BITS = 128
+
+# (pk bytes, msg bytes, sig bytes) — the argument order of the reference
+# crate's entry point, verify_bls_signature(sig, msg, key), normalized to
+# pk-first like ops/bls12_381.verify.
+SigTriple = tuple[bytes, bytes, bytes]
+
+
+def agg_transcript(seed: bytes, triples: list[SigTriple]) -> bytes:
+    """Fiat–Shamir transcript binding the batch weights to every
+    (pk, msg, sig) in the batch."""
+    h = hashlib.blake2b(digest_size=32)
+    h.update(AGG_DST)
+    h.update(seed)
+    for pk, msg, sig in triples:
+        h.update(pk)
+        h.update(hashlib.sha256(msg).digest())
+        h.update(sig)
+    return h.digest()
+
+
+def batch_weights(transcript: bytes, count: int) -> list[int]:
+    """128-bit nonzero weights, deterministic in the transcript."""
+    out = []
+    for b in range(count):
+        digest = hashlib.blake2b(
+            AGG_DST + transcript + b.to_bytes(8, "little"), digest_size=16
+        ).digest()
+        out.append(int.from_bytes(digest, "little") | 1)
+    return out
+
+
+def _hash_points(msgs: list[bytes]) -> list[G1Point]:
+    """H(msg) per message, hashing each distinct message once."""
+    memo: dict[bytes, G1Point] = {}
+    for m in msgs:
+        if m not in memo:
+            memo[m] = bls.hash_to_g1(m)
+    return [memo[m] for m in msgs]
+
+
+def batch_verify_signatures(
+    triples: list[SigTriple], seed: bytes = b""
+) -> bool:
+    """One combined pairing check for the whole batch.  False if ANY
+    signature is invalid (or any pk/sig fails to parse)."""
+    if not triples:
+        return True
+    try:
+        sig_pts = [G1Point.from_bytes(sig) for _, _, sig in triples]
+        pk_pts = {pk: G2Point.from_bytes(pk) for pk, _, _ in triples}
+    except ValueError:
+        return False
+    rhos = batch_weights(agg_transcript(seed, triples), len(triples))
+
+    # signature-side fold: one flat MSM over the whole batch
+    lhs = g1.msm(sig_pts, rhos, bits=_RHO_BITS)
+
+    # message-side folds, grouped by distinct public key
+    h_pts = _hash_points([msg for _, msg, _ in triples])
+    groups: dict[bytes, tuple[list[G1Point], list[int]]] = {}
+    for (pk, _, _), h, r in zip(triples, h_pts, rhos):
+        pts, rs = groups.setdefault(pk, ([], []))
+        pts.append(h)
+        rs.append(r)
+    keys = list(groups)
+    folds = g1.msm_grouped(
+        [groups[k][0] for k in keys],
+        [groups[k][1] for k in keys],
+        bits=_RHO_BITS,
+    )
+    pairs = [(lhs, -bls.G2_GENERATOR)]
+    pairs.extend((fold, pk_pts[k]) for k, fold in zip(keys, folds))
+    return bls.pairing_check(pairs)
+
+
+def verify_signatures(
+    triples: list[SigTriple], seed: bytes = b""
+) -> list[bool]:
+    """Per-signature verdicts: one combined check on the all-honest path,
+    bisection to isolate the invalid signatures otherwise."""
+    if not triples:
+        return []
+    if batch_verify_signatures(triples, seed):
+        return [True] * len(triples)
+    if len(triples) == 1:
+        return [False]
+    mid = len(triples) // 2
+    return verify_signatures(triples[:mid], seed) + verify_signatures(
+        triples[mid:], seed
+    )
+
+
+# ------------------------------------------------------- plain aggregation
+
+
+def aggregate_signatures(sigs: list[bytes]) -> bytes:
+    """Σ sig_i — the standard BLS aggregate (48-byte compressed G1)."""
+    acc = G1Point.infinity()
+    for s in sigs:
+        acc = acc + G1Point.from_bytes(s)
+    return acc.to_bytes()
+
+
+def verify_aggregate(
+    pks: list[bytes], msgs: list[bytes], agg_sig: bytes
+) -> bool:
+    """e(agg, −g2) · Π_K e(Σ_{i: pk_i=K} H(m_i), K) == 1.
+
+    Sound only for distinct messages per key (rogue-key/replay caveats are
+    the caller's contract, as in every BLS aggregate API); the batched
+    `batch_verify_signatures` path above has no such restriction."""
+    if len(pks) != len(msgs):
+        raise ValueError("pks/msgs length mismatch")
+    try:
+        agg = G1Point.from_bytes(agg_sig)
+        pk_pts = {pk: G2Point.from_bytes(pk) for pk in pks}
+    except ValueError:
+        return False
+    h_pts = _hash_points(msgs)
+    groups: dict[bytes, G1Point] = {}
+    for pk, h in zip(pks, h_pts):
+        groups[pk] = groups.get(pk, G1Point.infinity()) + h
+    pairs = [(agg, -bls.G2_GENERATOR)]
+    pairs.extend((fold, pk_pts[k]) for k, fold in groups.items())
+    return bls.pairing_check(pairs)

@@ -1,0 +1,93 @@
+"""Batched/aggregate BLS signature verification (ops/bls_agg.py).
+
+Anchored to the same per-signature semantics as ops/bls12_381.verify
+(itself pinned to the reference KATs at
+utils/verify-bls-signatures/tests/tests.rs → tests/test_bls12_381.py):
+the batch path must accept exactly the batches every individual check
+accepts.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from cess_tpu.ops import bls12_381 as bls
+from cess_tpu.ops import bls_agg
+
+
+def _make_batch(n: int, n_keys: int, tag: bytes = b""):
+    keys = [bls.keygen(b"agg-key-%d" % k + tag) for k in range(n_keys)]
+    pks = [bls.sk_to_pk(sk) for sk in keys]
+    triples = []
+    for i in range(n):
+        k = i % n_keys
+        msg = b"agg-msg-%d" % i + tag
+        triples.append((pks[k], msg, bls.sign(keys[k], msg)))
+    return triples
+
+
+class TestBatchVerify:
+    def test_honest_batch_accepts(self):
+        triples = _make_batch(6, 3)
+        assert bls_agg.batch_verify_signatures(triples, b"seed")
+        assert bls_agg.verify_signatures(triples, b"seed") == [True] * 6
+
+    def test_matches_individual_verdicts(self):
+        triples = _make_batch(4, 2)
+        for pk, msg, sig in triples:
+            assert bls.verify(pk, msg, sig)
+
+    def test_single_forgery_rejected_and_isolated(self):
+        triples = _make_batch(6, 2)
+        bad_sig = bls.sign(bls.keygen(b"wrong-key"), b"agg-msg-3")
+        triples[3] = (triples[3][0], triples[3][1], bad_sig)
+        assert not bls_agg.batch_verify_signatures(triples, b"seed")
+        verdicts = bls_agg.verify_signatures(triples, b"seed")
+        assert verdicts == [True, True, True, False, True, True]
+
+    def test_swapped_messages_rejected(self):
+        # each signature valid for the OTHER message: individual checks
+        # fail, and the weighted batch must not let them cancel
+        triples = _make_batch(2, 1)
+        (pk, m0, s0), (_, m1, s1) = triples
+        swapped = [(pk, m0, s1), (pk, m1, s0)]
+        assert not bls_agg.batch_verify_signatures(swapped, b"seed")
+        assert bls_agg.verify_signatures(swapped, b"seed") == [False, False]
+
+    def test_malformed_signature_bytes(self):
+        triples = _make_batch(2, 1)
+        triples[0] = (triples[0][0], triples[0][1], b"\x00" * 48)
+        assert not bls_agg.batch_verify_signatures(triples, b"seed")
+
+    def test_empty_batch(self):
+        assert bls_agg.batch_verify_signatures([], b"seed")
+        assert bls_agg.verify_signatures([], b"seed") == []
+
+    def test_seed_binds_weights(self):
+        t1 = bls_agg.agg_transcript(b"a", _make_batch(2, 1))
+        t2 = bls_agg.agg_transcript(b"b", _make_batch(2, 1))
+        assert t1 != t2
+        w = bls_agg.batch_weights(t1, 3)
+        assert len(set(w)) == 3 and all(x & 1 for x in w)
+
+
+class TestAggregate:
+    def test_aggregate_roundtrip(self):
+        triples = _make_batch(5, 2)
+        agg = bls_agg.aggregate_signatures([s for _, _, s in triples])
+        assert bls_agg.verify_aggregate(
+            [pk for pk, _, _ in triples], [m for _, m, _ in triples], agg
+        )
+
+    def test_aggregate_tampered_message_rejected(self):
+        triples = _make_batch(3, 1)
+        agg = bls_agg.aggregate_signatures([s for _, _, s in triples])
+        msgs = [m for _, m, _ in triples]
+        msgs[1] = b"tampered"
+        assert not bls_agg.verify_aggregate(
+            [pk for pk, _, _ in triples], msgs, agg
+        )
+
+    def test_aggregate_length_mismatch(self):
+        with pytest.raises(ValueError):
+            bls_agg.verify_aggregate([b"x"], [], b"y" * 48)

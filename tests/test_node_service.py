@@ -190,7 +190,7 @@ class TestRpcAndClients:
         user.submit("oss", "register", {"hex": b"http://gw".hex()})
         service.produce_block()
         events = user.call("state_getEvents", 5)
-        assert any(e.get("name") == "OssRegister" for e in events) or events
+        assert any(e.get("name") == "OssRegister" for e in events)
         user.close()
 
 
