@@ -51,6 +51,7 @@ _PALLETS = (
     "file_bank",
     "audit",
     "rrsc",
+    "evm",
 )
 
 # Nested data-bearing helpers the extractor recurses into.

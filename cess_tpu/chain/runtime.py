@@ -23,6 +23,7 @@ from ..utils.hashing import blake2b_256
 from .audit import AuditPallet
 from .rrsc import RrscPallet
 from .cacher import CacherPallet
+from .evm import EvmPallet
 from .file_bank import FileBankPallet
 from .oss import OssPallet
 from .scheduler_credit import SchedulerCreditPallet
@@ -114,6 +115,7 @@ class Runtime:
             chunk_count=cfg.podr2_chunk_count,
         )
         self.rrsc = RrscPallet(self.state, self.staking, self.scheduler_credit)
+        self.evm = EvmPallet(self.state)
 
         for acc, amount in cfg.endowed.items():
             self.state.balances.mint(acc, amount)

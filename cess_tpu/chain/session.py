@@ -1,0 +1,95 @@
+"""Session pallet: keyed authority sessions driving era rotation.
+
+Role match: stock `pallet_session` + `pallet_session::historical` as the
+reference wires them (runtime/src/lib.rs:1484-1527, session keys feeding
+the RRSC/GRANDPA/im-online authority sets; SessionsPerEra = 6 with 1 h
+epochs, runtime/src/lib.rs:245).  Collapsed onto this framework's
+deterministic runtime:
+
+ * accounts register session keys (`set_keys`/`purge_keys` — the opaque
+   SessionKeys blob role; here a single BLS public key per authority);
+ * the session index advances every `session_length` blocks; every
+   `sessions_per_era`-th rotation ends the staking era and runs the
+   credit-weighted RRSC election (chain/rrsc.py);
+ * each rotation records the validator-set digest in `historical` (the
+   pallet_session::historical root used for offence proofs) and
+   notifies registered observers (im-online's liveness sweep).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from .state import ChainState
+from .types import AccountId, ensure
+
+MOD = "session"
+
+
+class SessionPallet:
+    def __init__(
+        self,
+        state: ChainState,
+        staking,
+        rrsc,
+        session_length: int,
+        sessions_per_era: int = 6,
+    ) -> None:
+        self.state = state
+        self.staking = staking
+        self.rrsc = rrsc
+        self.session_length = max(1, session_length)
+        self.sessions_per_era = sessions_per_era
+        self.session_index: int = 0
+        self.keys: dict[AccountId, bytes] = {}
+        # session index -> hex digest of the active validator set (the
+        # historical-root role for offence proofs)
+        self.historical: dict[int, str] = {}
+        self._observers: list = []  # on_new_session(index, validators)
+
+    # ------------------------------------------------------------ keys
+
+    def set_keys(self, sender: AccountId, keys: bytes) -> None:
+        """Register an authority's session keys (stock set_keys; the
+        reference requires a bonded controller — same gate here)."""
+        ensure(len(keys) > 0, MOD, "EmptyKeys")
+        ensure(
+            sender in self.staking.ledger or sender in self.staking.bonded.values(),
+            MOD, "NoAssociatedValidatorId",
+        )
+        self.keys[sender] = bytes(keys)
+        self.state.deposit_event(MOD, "KeysSet", who=sender)
+
+    def purge_keys(self, sender: AccountId) -> None:
+        ensure(sender in self.keys, MOD, "NoKeys")
+        del self.keys[sender]
+        self.state.deposit_event(MOD, "KeysPurged", who=sender)
+
+    # ------------------------------------------------------------ hooks
+
+    def add_observer(self, fn) -> None:
+        """fn(session_index, ending_validator_set) at each rotation."""
+        self._observers.append(fn)
+
+    def validator_set_digest(self) -> str:
+        h = hashlib.blake2b(digest_size=16)
+        for v in sorted(self.staking.validators):
+            h.update(v.encode() + b"\x00" + self.keys.get(v, b""))
+        return h.hexdigest()
+
+    def on_initialize(self, now: int) -> None:
+        if now % self.session_length != 0:
+            return
+        ending = list(self.staking.validators)
+        for fn in self._observers:
+            fn(self.session_index, ending)
+        self.session_index += 1
+        # era boundary every sessions_per_era sessions
+        if self.session_index % self.sessions_per_era == 0:
+            self.staking.end_era()
+            if self.staking.candidates:
+                self.rrsc.rotate_epoch()
+        self.historical[self.session_index] = self.validator_set_digest()
+        self.state.deposit_event(
+            MOD, "NewSession", index=self.session_index
+        )

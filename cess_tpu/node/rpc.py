@@ -171,6 +171,90 @@ class RpcApi:
         def _vals():
             return _view(s.rt.staking.validators)
 
+        # ---- eth surface (node/src/rpc.rs:179-323 role): hex-quantity
+        # in/out per the eth JSON-RPC convention
+        def _h160(a: str) -> bytes:
+            raw = bytes.fromhex(a[2:] if a.startswith("0x") else a)
+            if len(raw) != 20:
+                raise RpcError(-32602, "bad address")
+            return raw
+
+        @method("eth_chainId")
+        def _eth_chain():
+            from ..chain.evm import CHAIN_ID
+
+            return hex(CHAIN_ID)
+
+        @method("eth_blockNumber")
+        def _eth_bn():
+            return hex(s.rt.state.block_number)
+
+        @method("eth_getBalance")
+        def _eth_bal(address: str, block: str = "latest"):
+            return hex(s.rt.evm.balances.get(_h160(address), 0))
+
+        @method("eth_getTransactionCount")
+        def _eth_nonce(address: str, block: str = "latest"):
+            from ..chain.evm import EvmAccount
+
+            return hex(s.rt.evm.accounts.get(_h160(address), EvmAccount()).nonce)
+
+        @method("eth_getCode")
+        def _eth_code(address: str, block: str = "latest"):
+            from ..chain.evm import EvmAccount
+
+            return "0x" + s.rt.evm.accounts.get(
+                _h160(address), EvmAccount()
+            ).code.hex()
+
+        @method("eth_getStorageAt")
+        def _eth_storage(address: str, slot: str, block: str = "latest"):
+            v = s.rt.evm.storage.get((_h160(address), int(slot, 16)), 0)
+            return "0x" + v.to_bytes(32, "big").hex()
+
+        @method("eth_call")
+        def _eth_call(tx: dict, block: str = "latest"):
+            """Read-only execution against current state (rolled back)."""
+            caller = _h160(tx.get("from", "0x" + "00" * 20))
+            data = bytes.fromhex(tx.get("data", "0x")[2:])
+            gas = int(tx.get("gas", "0x989680"), 16)
+            snap = s.rt.evm._snapshot()
+            try:
+                res = s.rt.evm.call(
+                    caller, _h160(tx["to"]), data=data,
+                    value=int(tx.get("value", "0x0"), 16), gas=gas,
+                )
+            finally:
+                s.rt.evm._restore(snap)
+            if not res.success:
+                raise RpcError(-32015, f"execution reverted: {res.error}")
+            return "0x" + res.return_data.hex()
+
+        @method("eth_estimateGas")
+        def _eth_estimate(tx: dict, block: str = "latest"):
+            from ..chain.evm import G_TX
+
+            caller = _h160(tx.get("from", "0x" + "00" * 20))
+            data = bytes.fromhex(tx.get("data", "0x")[2:])
+            snap = s.rt.evm._snapshot()
+            try:
+                if tx.get("to"):
+                    res = s.rt.evm.call(
+                        caller, _h160(tx["to"]), data=data,
+                        value=int(tx.get("value", "0x0"), 16),
+                        gas=30_000_000,
+                    )
+                else:
+                    res = s.rt.evm.create(
+                        caller, data, value=int(tx.get("value", "0x0"), 16),
+                        gas=30_000_000,
+                    )
+            finally:
+                s.rt.evm._restore(snap)
+            if not res.success:
+                raise RpcError(-32015, f"execution reverted: {res.error}")
+            return hex(res.gas_used + G_TX)
+
         # ---- dev helpers
         @method("dev_produceBlock")
         def _produce():

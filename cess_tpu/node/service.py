@@ -170,6 +170,16 @@ EXTRINSIC_DISPATCH: dict = {
     **{("audit", c): None for c in (
         "submit_proof", "submit_verify_result",
     )},
+    # pallet_evm call/create/deposit/withdraw role (reference:
+    # runtime/src/lib.rs:1322-1344)
+    **{("evm", c): None for c in ("deposit", "withdraw")},
+    ("evm", "transact_call"): lambda rt, sender, args: rt.evm.transact_call(
+        sender, _b(args[0]), _b(args[1]) if len(args) > 1 else b"",
+        *[int(a) for a in args[2:]],
+    ),
+    ("evm", "transact_create"): lambda rt, sender, args: rt.evm.transact_create(
+        sender, _b(args[0]), *[int(a) for a in args[1:]],
+    ),
 }
 
 

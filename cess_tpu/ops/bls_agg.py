@@ -83,10 +83,12 @@ def _hash_points(msgs: list[bytes]) -> list[G1Point]:
 
 
 def batch_verify_signatures(
-    triples: list[SigTriple], seed: bytes = b""
+    triples: list[SigTriple], seed: bytes = b"", mesh=None
 ) -> bool:
     """One combined pairing check for the whole batch.  False if ANY
-    signature is invalid (or any pk/sig fails to parse)."""
+    signature is invalid (or any pk/sig fails to parse).  mesh: optional
+    jax.sharding.Mesh — shards the signature-side fold over its devices
+    (parallel/msm.py), bit-identical to the single-device path."""
     if not triples:
         return True
     try:
@@ -97,7 +99,12 @@ def batch_verify_signatures(
     rhos = batch_weights(agg_transcript(seed, triples), len(triples))
 
     # signature-side fold: one flat MSM over the whole batch
-    lhs = g1.msm(sig_pts, rhos, bits=_RHO_BITS)
+    if mesh is not None:
+        from ..parallel.msm import msm_sharded
+
+        lhs = msm_sharded(mesh, sig_pts, rhos, bits=_RHO_BITS)
+    else:
+        lhs = g1.msm(sig_pts, rhos, bits=_RHO_BITS)
 
     # message-side folds, grouped by distinct public key
     h_pts = _hash_points([msg for _, msg, _ in triples])
@@ -118,19 +125,19 @@ def batch_verify_signatures(
 
 
 def verify_signatures(
-    triples: list[SigTriple], seed: bytes = b""
+    triples: list[SigTriple], seed: bytes = b"", mesh=None
 ) -> list[bool]:
     """Per-signature verdicts: one combined check on the all-honest path,
     bisection to isolate the invalid signatures otherwise."""
     if not triples:
         return []
-    if batch_verify_signatures(triples, seed):
+    if batch_verify_signatures(triples, seed, mesh):
         return [True] * len(triples)
     if len(triples) == 1:
         return [False]
     mid = len(triples) // 2
-    return verify_signatures(triples[:mid], seed) + verify_signatures(
-        triples[mid:], seed
+    return verify_signatures(triples[:mid], seed, mesh) + verify_signatures(
+        triples[mid:], seed, mesh
     )
 
 

@@ -10,5 +10,14 @@ gather in the loop.
 """
 
 from .verify import audit_data_plane_step, combine_mu_sharded, make_mesh
+from .msm import msm_sharded
+from .epoch_sim import EpochReport, run_epoch
 
-__all__ = ["audit_data_plane_step", "combine_mu_sharded", "make_mesh"]
+__all__ = [
+    "audit_data_plane_step",
+    "combine_mu_sharded",
+    "make_mesh",
+    "msm_sharded",
+    "run_epoch",
+    "EpochReport",
+]

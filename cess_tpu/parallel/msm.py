@@ -1,0 +1,96 @@
+"""Flat G1 MSM sharded over a device mesh.
+
+Σ_i [s_i]P_i is a bag of independent bucket accumulations plus one
+final fold, so the mesh layout is pure lane sharding: every device runs
+the Pippenger windowed-bucket kernel (ops/g1.py _msm_flat_kernel) over
+its lane shard, and the per-device partial sums — one projective point
+each — come back for a #devices-long host fold (point addition is not a
+`psum`-able arithmetic op, and folding 8 partials host-side is O(1)).
+
+This is the multi-chip shape of the batch-verification folds: the
+σ-side Π σ_b^{ρ_b} of the combined PoDR2 check (proof/xla_backend.py)
+and the signature-side fold of the aggregate BLS check (ops/bls_agg.py)
+at BASELINE config-5 scale.  Bit-identity with the single-device flat
+MSM (and the host fold) is asserted in tests/test_epoch_sim.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops import g1
+from ..ops.bls12_381 import G1Point
+from .verify import BATCH_AXIS
+
+_KERNEL_CACHE: dict = {}
+
+
+def _sharded_kernel(mesh: Mesh, n_windows: int):
+    key = (mesh, n_windows)
+    fn = _KERNEL_CACHE.get(key)
+    if fn is None:
+
+        def local(X, Y, Z, d):
+            rX, rY, rZ = g1._msm_flat_kernel(X, Y, Z, d, n_windows)
+            return rX[None], rY[None], rZ[None]  # (1, L): this device's shard
+
+        fn = jax.jit(
+            shard_map(
+                local,
+                mesh=mesh,
+                in_specs=(
+                    P(None, BATCH_AXIS),
+                    P(None, BATCH_AXIS),
+                    P(None, BATCH_AXIS),
+                    P(None, BATCH_AXIS),
+                ),
+                out_specs=(
+                    P(BATCH_AXIS, None),
+                    P(BATCH_AXIS, None),
+                    P(BATCH_AXIS, None),
+                ),
+                check_rep=False,
+            )
+        )
+        _KERNEL_CACHE[key] = fn
+    return fn
+
+
+def msm_sharded(
+    mesh: Mesh,
+    points: list[G1Point],
+    scalars: list[int],
+    bits: int = g1.SCALAR_BITS,
+) -> G1Point:
+    """Σ [s_i]P_i with the lane axis sharded over the mesh.  Scalars are
+    raw integers up to `bits` wide (flat-MSM semantics: no reduction mod
+    r — the cofactor-folding contract of ops/h2c.py)."""
+    if len(points) != len(scalars):
+        raise ValueError("points/scalars length mismatch")
+    if not points:
+        return G1Point.infinity()
+    n_dev = mesh.devices.size
+    n_windows = -(-bits // g1.LIMB_BITS)
+
+    # pad the lane axis so every device holds the same number of lanes
+    # (∞ with scalar 0 contributes nothing)
+    pad = (-len(points)) % n_dev
+    pts = list(points) + [G1Point.infinity()] * pad
+    scs = [int(s) for s in scalars] + [0] * pad
+
+    X, Y, Z = g1.points_to_projective(pts)  # (N, L)
+    d = g1.scalars_to_digits(scs, n_windows)  # (n_windows, N)
+    rX, rY, rZ = _sharded_kernel(mesh, n_windows)(
+        jnp.asarray(X.T), jnp.asarray(Y.T), jnp.asarray(Z.T), jnp.asarray(d)
+    )
+    partials = g1.projective_to_points(
+        np.asarray(rX), np.asarray(rY), np.asarray(rZ)
+    )
+    total = G1Point.infinity()
+    for p in partials:
+        total = total + p
+    return total
