@@ -102,18 +102,15 @@ def bench_verify(n_proofs: int) -> tuple[float, float]:
 
     def craft(names: list[bytes]) -> list:
         """Valid zero-data proofs: σ = (Π_c H(name,i_c)^{v_c})^sk, μ = 0.
-        Verifier-side work is identical to arbitrary-data proofs."""
-        from cess_tpu.ops import g1
+        Verifier-side work is identical to arbitrary-data proofs.  Crafted
+        through the fused device pipeline (proof/fused.py craft_sigmas:
+        σ = Π H^{sk·v_c mod r} — the same group element)."""
+        from cess_tpu.ops.bls12_381 import R
+        from cess_tpu.proof import fused
 
-        flat = podr2.chunk_points_batch(
-            [(nm, i) for nm in names for i in indices]
+        sigmas = fused.craft_sigmas(
+            names, challenge, [sk * v % R for v in coeffs]
         )
-        h_pts = [
-            flat[k * len(indices) : (k + 1) * len(indices)]
-            for k in range(len(names))
-        ]
-        inner = g1.msm_grouped(h_pts, [coeffs] * len(names), bits=160)
-        sigmas = g1.scalar_mul_batch(inner, [sk] * len(names))
         mu = [0] * params.s
         return [
             (nm, challenge, podr2.Podr2Proof(s.to_bytes(), list(mu)))
@@ -151,7 +148,7 @@ def bench_verify(n_proofs: int) -> tuple[float, float]:
 
 
 def main() -> None:
-    n_proofs = int(os.environ.get("BENCH_PROOFS", "128"))
+    n_proofs = int(os.environ.get("BENCH_PROOFS", "1024"))
     # power of two: the grouped MSM pads the batch to one anyway, and the
     # marginal-slope calculation below assumes the padded lanes scale
     # with the counted proofs

@@ -473,6 +473,32 @@ class G1Point:
         return "G1(inf)" if self.is_infinity() else f"G1({hex(self.x)},..)"
 
 
+def g1_decompress_unchecked(data: bytes) -> G1Point:
+    """Compressed G1 → point with encoding + on-curve validation but the
+    subgroup membership test DEFERRED (the fused verify pipeline runs it
+    as a batched device [r]-chain — ops/glv.py subgroup_mask — instead
+    of a per-point host ladder).  Raises ValueError for exactly the
+    encodings G1Point.from_bytes rejects before its subgroup test."""
+    if len(data) != 48:
+        raise ValueError("G1 compressed point must be 48 bytes")
+    flags = data[0]
+    if not flags & 0x80:
+        raise ValueError("uncompressed G1 encoding unsupported")
+    if flags & 0x40:
+        if any(data[1:]) or flags & 0x3F:
+            raise ValueError("invalid infinity encoding")
+        return G1Point.infinity()
+    x = int.from_bytes(bytes([flags & 0x1F]) + data[1:], "big")
+    if x >= P:
+        raise ValueError("x out of range")
+    y = fp_sqrt((x**3 + G1Point.B) % P)
+    if y is None:
+        raise ValueError("point not on curve")
+    if bool(flags & 0x20) != (y > P - y):
+        y = P - y
+    return G1Point(x, y)
+
+
 def _jac_double_fq2(x: Fq2, y: Fq2, z: Fq2) -> tuple[Fq2, Fq2, Fq2]:
     if z.is_zero() or y.is_zero():
         return FQ2_ZERO, FQ2_ONE, FQ2_ZERO

@@ -1,0 +1,360 @@
+"""GLV-accelerated G1 folds — the verify pipeline's fast MSM path.
+
+The PoDR2 batch verification's dominant cost is the H-side grouped MSM:
+per proof, 47 random-oracle points multiplied by 160-bit challenge
+coefficients (capability match: the per-signature hash/mul work inside
+the reference's verify loop, utils/verify-bls-signatures/src/lib.rs:
+85-100).  The ops/g1.py ladder prices that at `bits` double-adds per
+lane; this module cuts the per-lane work roughly in half by using the
+curve's degree-2 GLV endomorphism:
+
+  φ(x, y) = (βx, y)  with  φ(P) = [λ]P  on the r-order subgroup,
+  β a non-trivial cube root of unity in Fp, λ = z²−1 (128 bits,
+  λ² + λ + 1 ≡ 0 mod r).
+
+Scalars decompose by EXACT integer divmod — k = k2·λ + k1 with
+0 ≤ k1 < λ < 2^128 and k2 = k // λ < 2^128 for any k < r — so
+[k]P = [k1]P + [k2]φ(P) needs a 64-step 2-bit-window ladder over the
+16-entry table {aP + bφP} instead of a 255-step (or, with the
+cofactor folded into the scalar, 224-step) double-and-add.  No signed
+digits, no rounding: the identity is exact over the integers.
+
+Because φ(P) = [λ]P only holds on the r-order subgroup, the kernel
+first clears the cofactor with a fixed [h_eff] chain (h_eff =
+0xd201000000010001 has hamming weight 7: 63 doubles + 6 adds — cheaper
+than the 64 scalar bits it replaces, and it makes every downstream
+scalar reducible mod r).
+
+Everything runs over the ops/g1.py loose-limb field kernels; the Pallas
+tile kernel keeps the whole chain (clear → φ table → ladder) VMEM-
+resident, and the plain-XLA core is bit-identical for CPU meshes and
+the multi-chip dryrun (tests/test_glv.py asserts group-level equality
+with the host fold).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .bls12_381 import BLS_X, H_EFF_G1, P, R, G1Point
+from .g1 import (
+    L,
+    LIMB_BITS,
+    NP_LIMBS,
+    _FOLD_HIGHS,
+    _TABLE_OVERRIDE,
+    _pow_table,
+    _select,
+    _sub_pad,
+    fp_to_limbs,
+    mulm,
+    pt_add,
+    pt_double,
+)
+
+# λ = z² − 1 (z = −BLS_X): the eigenvalue of φ on the r-order subgroup.
+LAMBDA = (BLS_X * BLS_X - 1) % R
+assert (LAMBDA * LAMBDA + LAMBDA + 1) % R == 0
+
+K_BITS = 128  # both divmod halves fit 128 bits (λ ≈ 2^127.4, r/λ < 2^128)
+K_LIMBS = -(-K_BITS // LIMB_BITS) + 1  # 11 limbs + 1 headroom = 132+ bits
+N_WINDOWS = K_BITS // 2  # 64 two-bit windows
+
+
+@lru_cache(maxsize=1)
+def beta() -> int:
+    """The cube root of unity β with (βx, y) = [λ](x, y) on the subgroup.
+
+    Derived, not transcribed: of the two non-trivial roots of
+    t² + t + 1 over Fp, exactly one pairs with λ (the other pairs with
+    λ² ≡ −λ−1); pick it by testing against the generator."""
+    b = pow(2, (P - 1) // 3, P)
+    assert b != 1 and pow(b, 3, P) == 1
+    from .bls12_381 import G1_GENERATOR
+
+    lg = G1_GENERATOR.mul(LAMBDA)
+    for cand in (b, b * b % P):
+        if G1_GENERATOR.x * cand % P == lg.x and G1_GENERATOR.y == lg.y:
+            return cand
+    raise AssertionError("no cube root of unity matches lambda")
+
+
+def decompose(k: int) -> tuple[int, int]:
+    """k (mod r) → (k1, k2) with k ≡ k1 + k2·λ, both halves < 2^128."""
+    k %= R
+    k2, k1 = divmod(k, LAMBDA)
+    return k1, k2
+
+
+def decompose_to_limbs(scalars) -> tuple[np.ndarray, np.ndarray]:
+    """Scalars → ((K_LIMBS, N), (K_LIMBS, N)) int32 base-4096 digit arrays
+    of the divmod halves, limb-major for the ladder kernel."""
+    n = len(scalars)
+    k1 = np.zeros((n, K_LIMBS), dtype=np.int32)
+    k2 = np.zeros((n, K_LIMBS), dtype=np.int32)
+    for j, s in enumerate(scalars):
+        a, b = decompose(int(s))
+        for i in range(K_LIMBS):
+            k1[j, i] = a & 0xFFF
+            k2[j, i] = b & 0xFFF
+            a >>= LIMB_BITS
+            b >>= LIMB_BITS
+    return k1.T, k2.T
+
+
+# ------------------------------------------------------------ chain parts
+# All helpers trace through ops/g1.py field ops, so they work both in
+# plain XLA and inside a Pallas kernel (with _TABLE_OVERRIDE installed).
+
+
+def _limb_one(like: jnp.ndarray) -> jnp.ndarray:
+    limb0 = jax.lax.broadcasted_iota(jnp.int32, like.shape, 0) == 0
+    return jnp.where(limb0, 1, 0)
+
+
+def _infinity(like: jnp.ndarray):
+    zero = jnp.zeros_like(like)
+    return zero, _limb_one(like), zero
+
+
+def fixed_mul_static(P3, k: int):
+    """[k]P for a Python-static k: runs of doubles as fori_loops, adds
+    unrolled at the set bits (trace size ∝ hamming weight)."""
+    if k == 0:
+        return _infinity(P3[0])
+    bits = bin(k)[2:]
+    acc = P3
+    pos = 1
+    while pos < len(bits):
+        run = 0
+        while pos < len(bits) and bits[pos] == "0":
+            run += 1
+            pos += 1
+        ndbl = run + (1 if pos < len(bits) else 0)
+        if ndbl > 2:
+            acc = jax.lax.fori_loop(
+                0, ndbl, lambda _, a: pt_double(a), acc
+            )
+        else:
+            for _ in range(ndbl):
+                acc = pt_double(acc)
+        if pos < len(bits):  # the run ended at a set bit
+            acc = pt_add(acc, P3)
+            pos += 1
+    return acc
+
+
+def _phi(P3, beta_c):
+    return mulm(P3[0], beta_c), P3[1], P3[2]
+
+
+def _glv_table(P3, beta_c):
+    """(TX, TY, TZ) each (16, 33, N): T[4b + a] = [a]Q + [b]φ(Q)."""
+    inf = _infinity(P3[0])
+    q2 = pt_double(P3)
+    q3 = pt_add(q2, P3)
+    base = [inf, P3, q2, q3]
+    phis = [inf, _phi(P3, beta_c), _phi(q2, beta_c), _phi(q3, beta_c)]
+    rows = []
+    for b in range(4):
+        for a in range(4):
+            if a == 0:
+                rows.append(phis[b])
+            elif b == 0:
+                rows.append(base[a])
+            else:
+                rows.append(pt_add(base[a], phis[b]))
+    tx = jnp.stack([r[0] for r in rows])
+    ty = jnp.stack([r[1] for r in rows])
+    tz = jnp.stack([r[2] for r in rows])
+    return tx, ty, tz
+
+
+def _sel16(tx, ty, tz, idx):
+    """Per-lane 4-bit table pick via a binary select tree (no gathers —
+    Mosaic has no per-lane dynamic indexing along the lane axis)."""
+    outs = []
+    for t in (tx, ty, tz):
+        cur = t
+        for bit in (8, 4, 2, 1):
+            half = cur.shape[0] // 2
+            cond = (idx & bit) != 0
+            cur = jnp.where(cond[None, None, :], cur[half:], cur[:half])
+        outs.append(cur[0])
+    return tuple(outs)
+
+
+def _window_digits(l1, l2, sh):
+    d1 = (l1 >> sh) & 3
+    d2 = (l2 >> sh) & 3
+    return d1 + 4 * d2
+
+
+def _glv_ladder(tx, ty, tz, read_window):
+    """64-step MSB-first 2-bit ladder: acc = 4·acc + T[window]."""
+    def body(i, acc):
+        acc = pt_double(pt_double(acc))
+        t = _sel16(tx, ty, tz, read_window(i))
+        return pt_add(acc, t)
+
+    init = _infinity(tx[0])
+    return jax.lax.fori_loop(0, N_WINDOWS, body, init)
+
+
+def _glv_core(X, Y, Z, k1, k2, beta_c, clear: bool):
+    """Shared chain: optional cofactor clear → φ table → ladder.  k1/k2
+    are (K_LIMBS, N) int32 digit VALUES (the XLA path); the Pallas kernel
+    re-implements only the window read against its refs."""
+    pts = (X, Y, Z)
+    if clear:
+        pts = fixed_mul_static(pts, H_EFF_G1)
+    tx, ty, tz = _glv_table(pts, beta_c)
+
+    def read_window(i):
+        b = 2 * (N_WINDOWS - 1) - 2 * i  # MSB-first bit position
+        limb = b // LIMB_BITS
+        sh = b % LIMB_BITS
+        l1 = jax.lax.dynamic_index_in_dim(k1, limb, 0, keepdims=False)
+        l2 = jax.lax.dynamic_index_in_dim(k2, limb, 0, keepdims=False)
+        return _window_digits(l1, l2, sh)
+
+    return _glv_ladder(tx, ty, tz, read_window)
+
+
+@partial(jax.jit, static_argnames=("clear",))
+def _glv_fold_xla(X, Y, Z, k1, k2, clear: bool = True):
+    beta_c = jnp.asarray(fp_to_limbs(beta())).reshape(L, 1)
+    return _glv_core(X, Y, Z, k1, k2, beta_c, clear)
+
+
+# ------------------------------------------------------------ pallas path
+
+
+def _glv_tile_kernel(k1_ref, k2_ref, X_ref, Y_ref, Z_ref, t35_ref, t3_ref,
+                     t2_ref, pad_ref, beta_ref, oX_ref, oY_ref, oZ_ref,
+                     *, clear: bool):
+    """One VMEM-resident tile: clear → table → 64-step ladder with no HBM
+    round-trips.  Table/pad constants arrive as inputs (Pallas forbids
+    captured array constants) and install via g1._TABLE_OVERRIDE."""
+    from jax.experimental import pallas as pl
+
+    token = _TABLE_OVERRIDE.set(
+        {
+            "pow": {
+                h: ref[:]
+                for h, ref in zip(_FOLD_HIGHS, (t35_ref, t3_ref, t2_ref))
+            },
+            "subpad": pad_ref[:],
+        }
+    )
+    try:
+        pts = (X_ref[:], Y_ref[:], Z_ref[:])
+        if clear:
+            pts = fixed_mul_static(pts, H_EFF_G1)
+        tx, ty, tz = _glv_table(pts, beta_ref[:])
+
+        def read_window(i):
+            b = 2 * (N_WINDOWS - 1) - 2 * i
+            limb = b // LIMB_BITS
+            sh = b % LIMB_BITS
+            l1 = k1_ref[pl.ds(limb, 1), :][0]
+            l2 = k2_ref[pl.ds(limb, 1), :][0]
+            return _window_digits(l1, l2, sh)
+
+        aX, aY, aZ = _glv_ladder(tx, ty, tz, read_window)
+    finally:
+        _TABLE_OVERRIDE.reset(token)
+    oX_ref[:] = aX
+    oY_ref[:] = aY
+    oZ_ref[:] = aZ
+
+
+_GLV_TILE = 512
+
+
+def _glv_fold_pallas(X, Y, Z, k1, k2, clear: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = X.shape[1]
+    tile = min(_GLV_TILE, n)
+    spec_pt = pl.BlockSpec((L, tile), lambda i: (0, i))
+    spec_sc = pl.BlockSpec((K_LIMBS, tile), lambda i: (0, i))
+    t35, t3, t2 = (
+        jnp.asarray(_pow_table(NP_LIMBS, h)) for h in _FOLD_HIGHS
+    )
+    padv = jnp.asarray(np.asarray(_sub_pad())).reshape(L, 1)
+    beta_c = jnp.asarray(fp_to_limbs(beta())).reshape(L, 1)
+    full = lambda a: pl.BlockSpec(a.shape, lambda i: (0,) * a.ndim)  # noqa: E731
+
+    shape = jax.ShapeDtypeStruct((L, n), jnp.int32)
+    return pl.pallas_call(
+        partial(_glv_tile_kernel, clear=clear),
+        grid=(n // tile,),
+        in_specs=[
+            spec_sc, spec_sc, spec_pt, spec_pt, spec_pt,
+            full(t35), full(t3), full(t2), full(padv), full(beta_c),
+        ],
+        out_specs=[spec_pt, spec_pt, spec_pt],
+        out_shape=[shape, shape, shape],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024,
+        ),
+    )(k1, k2, X, Y, Z, t35, t3, t2, padv, beta_c)
+
+
+def glv_fold(X, Y, Z, k1, k2, clear: bool = True):
+    """Per-lane [k1 + k2·λ]([h_eff]P) (clear=True) or [k1 + k2·λ]P on
+    subgroup inputs (clear=False).  (33, N) limb arrays in, projective
+    accumulator triple out.  Fused Pallas tiles on TPU when the lane
+    count divides into tiles; bit-identical per-op XLA elsewhere."""
+    if jax.default_backend() == "tpu" and X.shape[1] % _GLV_TILE == 0:
+        return jax.jit(partial(_glv_fold_pallas, clear=clear))(
+            X, Y, Z, k1, k2
+        )
+    return _glv_fold_xla(X, Y, Z, k1, k2, clear=clear)
+
+
+# ------------------------------------------------------------ subgroup
+
+
+@lru_cache(maxsize=1)
+def _r_bits_msb() -> np.ndarray:
+    bits = bin(R)[2:]
+    return np.asarray([int(b) for b in bits], dtype=np.int32).reshape(-1, 1)
+
+
+def fixed_mul_bits(P3, bits_arr, nbits: int):
+    """[k]P with k given as an MSB-first (nbits, 1) bit array — the
+    generic double-and-(select)-add body, fori-looped (small trace)."""
+    X, Y, Z = P3
+
+    def body(i, acc):
+        acc = pt_double(acc)
+        sX, sY, sZ = pt_add(acc, (X, Y, Z))
+        b = jax.lax.dynamic_index_in_dim(bits_arr, i, 0, keepdims=False)[0]
+        cond = b == 1
+        return (
+            _select(cond, sX, acc[0]),
+            _select(cond, sY, acc[1]),
+            _select(cond, sZ, acc[2]),
+        )
+
+    return jax.lax.fori_loop(0, nbits, body, _infinity(X))
+
+
+@jax.jit
+def subgroup_mask(X, Y, Z):
+    """(N,) int32: 1 where [r]P = ∞ (P in the r-order subgroup, or P = ∞).
+    Adversarial σ points must pass this before GLV math may assume the
+    λ eigenvalue — the device analog of G1Point.from_bytes' host check
+    (ops/bls12_381.py in_subgroup)."""
+    from .h2c import _is_zero_mod_p
+
+    bits = jnp.asarray(_r_bits_msb())
+    _, _, accZ = fixed_mul_bits((X, Y, Z), bits, bits.shape[0])
+    return _is_zero_mod_p(accZ).astype(jnp.int32)

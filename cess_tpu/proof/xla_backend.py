@@ -61,8 +61,18 @@ class XlaBackend(ProofBackend):
 
     name = "xla"
 
-    def __init__(self, mesh=None, device_h2c: bool | None = None) -> None:
+    def __init__(
+        self,
+        mesh=None,
+        device_h2c: bool | None = None,
+        fused: bool | None = None,
+    ) -> None:
         self.mesh = mesh
+        # fused: None = auto (the single-program GLV pipeline of
+        # proof/fused.py on a real TPU); True/False force it — tests
+        # force True to exercise the fused path on the CPU mesh.
+        # Verdicts are bit-identical either way (tests/test_fused.py).
+        self.fused = fused
         # device_h2c: None = auto (device SSWU only on a real TPU, where
         # the fused Pallas map wins); True/False force it — tests force
         # True to exercise the wiring on the CPU mesh.  On CPU the
@@ -163,6 +173,15 @@ class XlaBackend(ProofBackend):
         """
         if not items:
             return True
+        use_fused = (
+            self.fused
+            if self.fused is not None
+            else jax.default_backend() == "tpu" and self.mesh is None
+        )
+        if use_fused:
+            from .fused import combined_check_fused
+
+            return combined_check_fused(pk, items, seed, params)
         try:
             pk_point = G2Point.from_bytes(pk)
             sigmas = [G1Point.from_bytes(p.sigma) for _, _, p in items]

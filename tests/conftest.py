@@ -21,6 +21,11 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# Persistent compile cache: the fused-verify / map / ladder programs take
+# minutes to build on CPU; cache them across test runs and CI jobs.
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_cess")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
 
 
 def pytest_addoption(parser):

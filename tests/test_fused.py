@@ -1,0 +1,173 @@
+"""Fused verify pipeline (proof/fused.py) + GLV kernels (ops/glv.py):
+bit-identity with the host reference on the CPU mesh."""
+
+import random
+
+import numpy as np
+import pytest
+
+from cess_tpu.ops import g1, glv, podr2
+from cess_tpu.ops import bls12_381 as bls
+from cess_tpu.ops.bls12_381 import G1Point, G1_GENERATOR, R
+from cess_tpu.ops.podr2 import Challenge, Podr2Params, keygen, tag_fragment
+from cess_tpu.proof import CpuBackend, XlaBackend
+
+PARAMS = Podr2Params(n=8, s=4)
+SK, PK = keygen(b"fused-tee")
+
+
+def make_challenge(indices, seed=b"f"):
+    randoms = tuple(
+        (seed + i.to_bytes(2, "little")).ljust(20, b"\x5a") for i in indices
+    )
+    return Challenge(indices=tuple(indices), randoms=randoms)
+
+
+@pytest.fixture(scope="module")
+def proved():
+    ch = make_challenge([0, 2, 5])
+    items = []
+    for k in range(3):
+        name = f"fused-frag-{k}".encode()
+        data = bytes(
+            [(k * 31 + i) % 256 for i in range(PARAMS.fragment_bytes)]
+        )
+        tags = tag_fragment(SK, name, data, PARAMS)
+        items.append((name, ch, podr2.prove(tags, data, ch, PARAMS)))
+    return items
+
+
+def fused_backend():
+    return XlaBackend(fused=True)
+
+
+class TestGlv:
+    def test_decompose_identity(self):
+        rnd = random.Random(3)
+        for _ in range(50):
+            k = rnd.getrandbits(rnd.choice([64, 128, 160, 255])) % R
+            k1, k2 = glv.decompose(k)
+            assert k1 + k2 * glv.LAMBDA == k
+            assert 0 <= k1 < 1 << 128 and 0 <= k2 < 1 << 128
+
+    def test_phi_eigenvalue(self):
+        b = glv.beta()
+        p = G1_GENERATOR.mul(12345)
+        assert G1Point(p.x * b % bls.P, p.y) == p.mul(glv.LAMBDA)
+
+    def test_glv_fold_matches_host(self):
+        import jax.numpy as jnp
+
+        rnd = random.Random(9)
+        pts = [
+            bls.map_to_curve_g1(rnd.getrandbits(300) % bls.P)
+            for _ in range(8)
+        ]
+        scalars = [rnd.getrandbits(160) for _ in range(8)]
+        X, Y, Z = g1.points_to_projective(pts)
+        k1, k2 = glv.decompose_to_limbs(scalars)
+        aX, aY, aZ = glv.glv_fold(
+            jnp.asarray(X.T), jnp.asarray(Y.T), jnp.asarray(Z.T),
+            jnp.asarray(k1), jnp.asarray(k2), clear=True,
+        )
+        got = g1.projective_to_points(
+            np.asarray(aX).T, np.asarray(aY).T, np.asarray(aZ).T
+        )
+        want = [
+            p._mul_raw(bls.H_EFF_G1)._mul_raw(s % R)
+            for p, s in zip(pts, scalars)
+        ]
+        assert got == want
+
+    def test_subgroup_mask(self):
+        import jax.numpy as jnp
+
+        rnd = random.Random(5)
+        sub = [G1_GENERATOR.mul(rnd.getrandbits(200)) for _ in range(3)]
+        nonsub = [
+            bls.map_to_curve_g1(rnd.getrandbits(300) % bls.P)
+            for _ in range(3)
+        ]
+        sub.append(G1Point.infinity())
+        nonsub.append(G1_GENERATOR.mul(7))
+        X, Y, Z = g1.points_to_projective(sub + nonsub)
+        m = np.asarray(
+            glv.subgroup_mask(
+                jnp.asarray(X.T), jnp.asarray(Y.T), jnp.asarray(Z.T)
+            )
+        )
+        assert m.tolist() == [1, 1, 1, 1, 0, 0, 0, 1]
+
+
+class TestFusedVerdicts:
+    def test_all_honest(self, proved):
+        assert fused_backend().verify_batch(
+            PK, proved, b"round", PARAMS
+        ) == [True] * 3
+
+    def test_one_bad_mu(self, proved):
+        bad = list(proved)
+        name, ch, proof = bad[1]
+        t = podr2.Podr2Proof(proof.sigma, list(proof.mu))
+        t.mu[0] = (t.mu[0] + 1) % R
+        bad[1] = (name, ch, t)
+        cpu = CpuBackend().verify_batch(PK, bad, b"round", PARAMS)
+        fus = fused_backend().verify_batch(PK, bad, b"round", PARAMS)
+        assert cpu == [True, False, True]
+        assert cpu == fus
+
+    def test_bad_sigma_encoding(self, proved):
+        bad = list(proved)
+        name, ch, proof = bad[0]
+        bad[0] = (name, ch, podr2.Podr2Proof(b"\x00" * 48, list(proof.mu)))
+        cpu = CpuBackend().verify_batch(PK, bad, b"round", PARAMS)
+        fus = fused_backend().verify_batch(PK, bad, b"round", PARAMS)
+        assert cpu == fus == [False, True, True]
+
+    def test_non_subgroup_sigma(self, proved):
+        # a curve point outside the r-order subgroup, validly compressed
+        rnd = random.Random(11)
+        p = bls.map_to_curve_g1(rnd.getrandbits(300) % bls.P)
+        assert not p.in_subgroup()
+        raw = bytearray(p.x.to_bytes(48, "big"))
+        raw[0] |= 0x80
+        if p.y > bls.P - p.y:
+            raw[0] |= 0x20
+        bad = list(proved)
+        name, ch, proof = bad[2]
+        bad[2] = (name, ch, podr2.Podr2Proof(bytes(raw), list(proof.mu)))
+        cpu = CpuBackend().verify_batch(PK, bad, b"round", PARAMS)
+        fus = fused_backend().verify_batch(PK, bad, b"round", PARAMS)
+        assert cpu == fus == [True, True, False]
+
+    def test_mu_out_of_range(self, proved):
+        bad = list(proved)
+        name, ch, proof = bad[0]
+        bad[0] = (name, ch, podr2.Podr2Proof(proof.sigma, [R] + proof.mu[1:]))
+        cpu = CpuBackend().verify_batch(PK, bad, b"round", PARAMS)
+        fus = fused_backend().verify_batch(PK, bad, b"round", PARAMS)
+        assert cpu == fus == [False, True, True]
+
+    def test_ragged_challenges(self):
+        """Items with different challenge widths + zip truncation."""
+        ch_a = make_challenge([0, 3])
+        ch_b = Challenge(
+            indices=(1, 4, 6),
+            randoms=(b"r1".ljust(20, b"\x01"), b"r2".ljust(20, b"\x02")),
+        )  # truncates to 2 pairs
+        items = []
+        for k, ch in ((0, ch_a), (1, ch_b)):
+            name = f"ragged-{k}".encode()
+            data = bytes(
+                [(k * 7 + i) % 256 for i in range(PARAMS.fragment_bytes)]
+            )
+            tags = tag_fragment(SK, name, data, PARAMS)
+            items.append((name, ch, podr2.prove(tags, data, ch, PARAMS)))
+        cpu = CpuBackend().verify_batch(PK, items, b"rag", PARAMS)
+        fus = fused_backend().verify_batch(PK, items, b"rag", PARAMS)
+        assert cpu == fus == [True, True]
+
+    def test_single_item(self, proved):
+        cpu = CpuBackend().verify_batch(PK, proved[:1], b"one", PARAMS)
+        fus = fused_backend().verify_batch(PK, proved[:1], b"one", PARAMS)
+        assert cpu == fus == [True]

@@ -1,0 +1,91 @@
+"""Pure device-compute timings for the verify kernels (resident inputs)."""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def timeit(label, fn, *args, reps=3):
+    out = fn(*args)
+    jax.block_until_ready(out)  # compile
+    best = 1e9
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+    print(f"  {label:44s} {best * 1000:9.1f} ms", file=sys.stderr, flush=True)
+    return best
+
+
+def main():
+    from cess_tpu.ops import g1, h2c
+
+    rng = np.random.default_rng(7)
+
+    # ---- transfers with random data
+    for mb in (1, 4, 16):
+        h = rng.integers(0, 1 << 30, size=(mb * 256 * 1024,), dtype=np.int32)
+        d = jax.device_put(h); jax.block_until_ready(d)
+        t0 = time.perf_counter(); d = jax.device_put(h); jax.block_until_ready(d)
+        print(f"  h2d random int32 {mb}MB: {(time.perf_counter()-t0)*1e3:.1f} ms",
+              file=sys.stderr)
+        t0 = time.perf_counter(); _ = np.asarray(d)
+        print(f"  d2h random int32 {mb}MB: {(time.perf_counter()-t0)*1e3:.1f} ms",
+              file=sys.stderr)
+
+    N = int(os.environ.get("PROF_LANES", "65536"))
+    print(f"N={N} lanes", file=sys.stderr)
+
+    # ---- SSWU map kernel, device-resident inputs
+    u = jnp.asarray(rng.integers(0, 4096, size=(33, 2, N), dtype=np.int32))
+    sgn = jnp.asarray(rng.integers(0, 2, size=(2, N), dtype=np.int32))
+    exc = jnp.zeros((2, N), jnp.int32)
+    dt = timeit("SSWU map kernel", lambda: h2c._map_pairs_kernel(u, sgn, exc))
+    print(f"    -> {dt / N * 1e6:.2f} us/pair; per proof(47): {dt / N * 47 * 1e3:.3f} ms",
+          file=sys.stderr)
+
+    X = jnp.asarray(rng.integers(0, 4096, size=(33, N), dtype=np.int32))
+    Y = jnp.asarray(rng.integers(0, 4096, size=(33, N), dtype=np.int32))
+    Z = jnp.asarray(rng.integers(0, 4096, size=(33, N), dtype=np.int32))
+
+    # ---- grouped ladder MSM at various bit widths
+    for bits in (224, 160, 128):
+        s = jnp.asarray(
+            rng.integers(0, 4096, size=(g1.R_LIMBS, N), dtype=np.int32))
+        dt = timeit(f"grouped ladder MSM bits={bits} g=64",
+                    lambda s=s, bits=bits: g1._msm_kernel(
+                        X, Y, Z, s, bits=bits, group=64))
+        print(f"    -> per proof(64 lanes): {dt / (N // 64) * 1e3:.3f} ms",
+              file=sys.stderr)
+
+    # ---- flat Pippenger at 352 and 160 bits
+    for bits in ():
+        nw = -(-bits // 12)
+        d = jnp.asarray(rng.integers(0, 4096, size=(nw, N), dtype=np.int32))
+        dt = timeit(f"flat Pippenger bits={bits} ({nw} win)",
+                    lambda d=d, bits=bits: g1.msm_flat_device((X, Y, Z), np.asarray(d), bits))
+        print(f"    -> per proof(47 lanes): {dt / (N / 47) * 1e3:.3f} ms",
+              file=sys.stderr)
+
+    # ---- small-lane ladder (sigma/u side shapes)
+    for lanes, bits in ((1024, 128), (256, 255)):
+        Xs, Ys, Zs = X[:, :lanes], Y[:, :lanes], Z[:, :lanes]
+        s = jnp.asarray(
+            rng.integers(0, 4096, size=(g1.R_LIMBS, lanes), dtype=np.int32))
+        timeit(f"flat ladder MSM lanes={lanes} bits={bits}",
+               lambda Xs=Xs, Ys=Ys, Zs=Zs, s=s, bits=bits: g1._msm_kernel(
+                   Xs, Ys, Zs, s, bits=bits))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,156 @@
+"""Component-level profile of XlaBackend verify at bench geometry.
+
+Times each stage of _combined_check separately on the real chip:
+proofgen, rho derivation, mu combine (fr), sigma MSM, host XMD,
+device SSWU map, grouped H-MSM, rho fold, u-side MSM, pairing.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def t(label, fn, *args, **kw):
+    import jax
+
+    t0 = time.perf_counter()
+    out = fn(*args, **kw)
+    try:
+        jax.block_until_ready(out)
+    except Exception:
+        pass
+    dt = time.perf_counter() - t0
+    print(f"  {label:30s} {dt * 1000:9.1f} ms", file=sys.stderr, flush=True)
+    return out, dt
+
+
+def main():
+    import jax
+
+    from cess_tpu.ops import fr, g1, h2c, podr2
+    from cess_tpu.ops import bls12_381 as bls
+    from cess_tpu.ops.bls12_381 import G1Point, G2Point
+    from cess_tpu.ops.podr2 import Challenge, Podr2Params
+    from cess_tpu.proof import XlaBackend
+
+    B = int(os.environ.get("PROF_PROOFS", "128"))
+    params = Podr2Params()
+    sk, pk = podr2.keygen(b"bench-tee")
+    rnd = random.Random(0xBE7C)
+    indices = tuple(sorted(rnd.sample(range(params.n), 47)))
+    randoms = tuple(rnd.randbytes(20) for _ in indices)
+    challenge = Challenge(indices=indices, randoms=randoms)
+    coeffs = challenge.coefficients()
+
+    names = [b"bench-frag-%08d" % i for i in range(B)]
+    t0 = time.perf_counter()
+    flat = podr2.chunk_points_batch([(nm, i) for nm in names for i in indices])
+    h_pts = [flat[k * len(indices):(k + 1) * len(indices)] for k in range(B)]
+    inner0 = g1.msm_grouped(h_pts, [coeffs] * B, bits=160)
+    sigmas_pts = g1.scalar_mul_batch(inner0, [sk] * B)
+    mu = [0] * params.s
+    items = [(nm, challenge, podr2.Podr2Proof(s.to_bytes(), list(mu)))
+             for nm, s in zip(names, sigmas_pts)]
+    print(f"proofgen: {time.perf_counter() - t0:.2f}s", file=sys.stderr)
+
+    backend = XlaBackend()
+    podr2.chunk_point.cache_clear()
+
+    # warm everything once end to end
+    t0 = time.perf_counter()
+    v = backend.verify_batch(pk, items, b"bench-seed", params)
+    assert all(v)
+    print(f"warm full verify: {time.perf_counter() - t0:.2f}s", file=sys.stderr)
+
+    # Now break down stages (second run, compiled).
+    backend._h_memo = {}
+    print(f"B={B} breakdown:", file=sys.stderr)
+
+    pk_point = G2Point.from_bytes(pk)
+    sigmas = [G1Point.from_bytes(p.sigma) for _, _, p in items]
+    batch_items = [podr2.BatchItem(n, c, p) for n, c, p in items]
+    rhos, dt_rho = t("batch_rho", lambda: podr2.batch_rho(
+        podr2.batch_transcript(b"bench-seed", batch_items), len(items)))
+
+    mu_limbs = np.stack([fr.fr_to_limbs(p.mu) for _, _, p in items])
+    _, dt_mu = t("mu combine (fr)", lambda: fr.combine_mu(rhos, mu_limbs))
+    exps = fr.limbs_to_ints(fr.combine_mu(rhos, mu_limbs))
+
+    lhs, dt_sig = t("sigma MSM (flat B)", lambda: g1.msm(sigmas, rhos, bits=128))
+
+    # h2c front half: host XMD
+    counts = [min(len(ch.indices), len(ch.randoms)) for _, ch, _ in items]
+    name_ids = np.repeat(np.arange(B, dtype=np.uint32), counts)
+    idxs = np.concatenate([np.asarray(ch.indices[:c], dtype=np.uint64)
+                           for (_, ch, _), c in zip(items, counts)])
+    (ulimbs_pack, dt_xmd) = t("host XMD (native)", lambda: h2c.u_for_pairs(
+        names, name_ids, idxs, podr2.H_DST))
+    u_limbs, sgn, exc = ulimbs_pack
+
+    import jax.numpy as jnp
+    (padded, m) = h2c._pad_pow2_lanes([u_limbs, sgn, exc], len(name_ids))
+    u_d, s_d, e_d = (jnp.asarray(a) for a in padded)
+    print(f"  (pairs={len(name_ids)}, padded lanes={m})", file=sys.stderr)
+    _, dt_map = t("device SSWU map", lambda: h2c._map_pairs_kernel(u_d, s_d, e_d))
+    (X, Y, Z) = h2c._map_pairs_kernel(u_d, s_d, e_d)
+
+    # grouped MSM exactly as _h_inner_fold_device does
+    def grouped():
+        g = 1 << max(0, (max(counts) - 1).bit_length())
+        Bp = 1 << max(0, (B - 1).bit_length())
+        lane_map = np.zeros((Bp, g), dtype=np.int32)
+        slimbs = np.zeros((Bp, g, g1.R_LIMBS), dtype=np.int32)
+        limb_cache = {}
+
+        def limbs_of(v):
+            row = limb_cache.get(v)
+            if row is None:
+                row = g1.scalars_to_digits([v], g1.R_LIMBS)[:, 0]
+                limb_cache[v] = row
+            return row
+
+        pos = 0
+        for b, ((_, ch, _), cnt) in enumerate(zip(items, counts)):
+            cf = ch.coefficients()[:cnt]
+            for k, vv in enumerate(cf):
+                lane_map[b, k] = pos + k
+                slimbs[b, k] = limbs_of(vv * h2c.H_EFF)
+            pos += cnt
+        flat2 = lane_map.reshape(-1)
+        Xg = jnp.take(X, jnp.asarray(flat2), axis=1)
+        Yg = jnp.take(Y, jnp.asarray(flat2), axis=1)
+        Zg = jnp.take(Z, jnp.asarray(flat2), axis=1)
+        s = jnp.asarray(slimbs.reshape(Bp * g, g1.R_LIMBS).T)
+        rX, rY, rZ = g1._msm_kernel(Xg, Yg, Zg, s, bits=224, group=g)
+        return np.asarray(rX), np.asarray(rY), np.asarray(rZ)
+
+    (rXYZ, dt_gmsm) = t("grouped H-MSM (scalar prep + kernel)", grouped)
+    rX, rY, rZ = rXYZ
+    inner = g1.projective_to_points(rX.T[:B], rY.T[:B], rZ.T[:B])
+
+    _, dt_fold = t("rho fold MSM (flat B)", lambda: g1.msm(inner, rhos, bits=128))
+    rhs = g1.msm(inner, rhos, bits=128)
+
+    us = list(podr2.u_generators(params.s))
+    _, dt_umsm = t("u-side MSM (s=265)", lambda: g1.msm(us, exps))
+    rhs = rhs + g1.msm(us, exps)
+
+    _, dt_pair = t("pairing check", lambda: bls.pairing_check(
+        [(lhs, -bls.G2_GENERATOR), (rhs, pk_point)]))
+
+    total = (dt_rho + dt_mu + dt_sig + dt_xmd + dt_map + dt_gmsm + dt_fold
+             + dt_umsm + dt_pair)
+    print(f"  {'SUM':30s} {total * 1000:9.1f} ms", file=sys.stderr)
+    print(f"  per-proof if all scales: {total / B * 1000:.2f} ms",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
