@@ -275,35 +275,38 @@ class AuditPallet:
 
         h = challenge_info.proposal_hash()
         count = len(self.keys)
-        limit = count * 2 // 3
+        # 2/3 supermajority, rounded UP (same threshold as the finality
+        # gadget's sync.quorum — floor division would let 1 of 2 or 2 of
+        # 4 authorities commit a round alone).  ceil(2n/3) is 1 for a
+        # single-authority dev chain, so its own vote still commits.
+        limit = max((2 * count + 2) // 3, 1)
         ensure(
             key not in self.proposal_voters.get(h, set()),
             MOD,
             "InvalidUnsigned",
             "duplicate vote",
         )
+        if h not in self.challenge_proposal and len(
+            self.challenge_proposal
+        ) > count:
+            self.challenge_proposal.clear()
+            self.proposal_voters.clear()
         self.proposal_voters.setdefault(h, set()).add(key)
-        if h in self.challenge_proposal:
-            votes, info = self.challenge_proposal[h]
-            self.challenge_proposal[h] = (votes + 1, info)
-            if votes + 1 >= limit:
-                now = self.state.block_number
-                if now > self.challenge_duration:
-                    self.challenge_snap_shot = info
-                    duration = now + info.net_snap_shot.life
-                    self.challenge_duration = duration
-                    self.verify_duration = (
-                        duration + info.net_snap_shot.life + self.one_hour_block
-                    )
-                    self.challenge_proposal.clear()
-                    self.proposal_voters.clear()
-                self.state.deposit_event(MOD, "GenerateChallenge")
-        else:
-            if len(self.challenge_proposal) > count:
+        votes, info = self.challenge_proposal.get(h, (0, challenge_info))
+        votes += 1
+        self.challenge_proposal[h] = (votes, info)
+        if votes >= limit:
+            now = self.state.block_number
+            if now > self.challenge_duration:
+                self.challenge_snap_shot = info
+                duration = now + info.net_snap_shot.life
+                self.challenge_duration = duration
+                self.verify_duration = (
+                    duration + info.net_snap_shot.life + self.one_hour_block
+                )
                 self.challenge_proposal.clear()
                 self.proposal_voters.clear()
-            else:
-                self.challenge_proposal[h] = (1, challenge_info)
+            self.state.deposit_event(MOD, "GenerateChallenge")
 
     # ------------------------------------------------------------ proofs
 
@@ -451,10 +454,21 @@ class AuditPallet:
     def unlock_offchain(self, authority: AccountId) -> None:
         self._ocw_lock.pop(authority, None)
 
-    def offchain_worker(self, now: BlockNumber, authority: AccountId):
+    def offchain_worker(
+        self,
+        now: BlockNumber,
+        authority: AccountId,
+        submit: Callable | None = None,
+    ):
         """One validator's OCW pass: maybe generate + vote a challenge
         (reference: lib.rs:342-359, 759-780).  Returns the ChallengeInfo it
-        voted (for tests), else None."""
+        voted (for tests), else None.
+
+        `submit` is the transaction-submission seam (the reference's
+        SubmitTransaction::submit_unsigned_transaction): when given, the
+        vote is handed to it (a live node routes it through its own tx
+        pool so every replica applies it in block order) instead of being
+        written into local state directly (the in-process sim path)."""
         if now <= self.verify_duration:
             return None
         if not self.trigger_challenge(now):
@@ -468,7 +482,10 @@ class AuditPallet:
         except DispatchError:
             self.unlock_offchain(authority)
             return None
-        self.save_challenge_info(info, authority, signature=None)
+        if submit is not None:
+            submit(info)
+        else:
+            self.save_challenge_info(info, authority, signature=None)
         self.unlock_offchain(authority)
         return info
 
@@ -516,6 +533,11 @@ class AuditPallet:
                 )
                 if len(miner_list) > CHALLENGE_MINER_MAX:
                     raise DispatchError(MOD, "GenerateInfoError")
+
+        # An empty snapshot would commit a round nobody can answer and
+        # stall the audit until verify_duration passes — no challenge
+        # without at least one challengeable (powered, unlocked) miner.
+        ensure(len(miner_list) > 0, MOD, "GenerateInfoError")
 
         # 46/1000 density: 47 of 1024 (reference: audit/src/lib.rs:906).
         need_count = max(1, self.chunk_count * 46 // 1000)

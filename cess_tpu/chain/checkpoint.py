@@ -15,13 +15,17 @@ the equivalents for the framework's in-memory runtime:
    anchor (same genesis + same extrinsics ⇒ same hash), asserted in
    tests/test_checkpoint.py.
  * `snapshot(rt)` / `restore(rt, blob)` — ExportState/warp-sync shape.
-   The blob IS the canonical encoding (state_hash(snapshot) is just
-   sha256 of the blob): a pure data format with its own decoder — no
+   The blob is a VERSIONED header (magic + format version) over the
+   canonical encoding: a pure data format with its own decoder — no
    pickle, so an untrusted blob can at worst fail to parse, never
-   execute code.  Restoring loads the data into a FRESHLY CONSTRUCTED
-   runtime (same genesis config); wiring — pallet cross-references,
-   injected verifiers, backends — is re-created by construction and
-   never travels.
+   execute code.  Sync catch-up exchanges these blobs between nodes of
+   possibly different builds, so `restore` upgrades older payloads
+   through the MIGRATIONS registry (the storage-migration role,
+   reference: c-pallets/audit/src/migrations.rs:9-41) and rejects
+   blobs newer than this build.  Restoring loads the data into a
+   FRESHLY CONSTRUCTED runtime (same genesis config); wiring — pallet
+   cross-references, injected verifiers, backends — is re-created by
+   construction and never travels.
 
 Attribute classification is LOUD: plain data is captured; known
 structural values (pallet cross-references, ChainState back-refs,
@@ -61,6 +65,12 @@ _NESTED_TYPES = {"Balances", "Agenda"}
 # (None), so the hash does not depend on whether a verifier is plugged in.
 _WIRING_FIELDS = {"result_verifier", "cert_verifier"}
 
+# Offchain-local storage: per-node worker state (the reference keeps it
+# in the offchain DB, not the state trie).  Each validator's OCW lock
+# advances independently, so including it would make replica state
+# hashes diverge the moment different authorities run their workers.
+_OFFCHAIN_FIELDS = {"_ocw_lock"}
+
 
 def _is_structural(value: Any) -> bool:
     """Pallet cross-references and similar wiring reachable from pallet
@@ -93,7 +103,7 @@ def _object_state(obj: Any, where: str) -> dict[str, Any]:
     that is neither data nor a recognized structural reference."""
     out = {}
     for name, value in vars(obj).items():
-        if name in _WIRING_FIELDS:
+        if name in _WIRING_FIELDS or name in _OFFCHAIN_FIELDS:
             continue
         if _is_data(value):
             out[name] = value
@@ -275,6 +285,36 @@ def _dataclass_registry() -> dict[str, type]:
     return out
 
 
+# ------------------------------------------------------------ versioning
+#
+# Snapshot blobs travel between nodes (sync_checkpoint catch-up) and
+# across builds (export-state files), so the format is version-tagged:
+#
+#   MAGIC ‖ u16 version ‖ canonical payload
+#
+# v1: bare canonical encoding, no header (the original format — still
+#     accepted on read).
+# v2: header introduced; payload layout unchanged.
+#
+# MIGRATIONS[v] upgrades a decoded v payload dict to v+1; restore runs
+# the chain v → FORMAT_VERSION, so any supported older blob loads into
+# the current runtime (the on_runtime_upgrade role, reference:
+# c-pallets/audit/src/migrations.rs:9-41).  Later format bumps add an
+# entry here instead of breaking old fixtures.
+
+MAGIC = b"CESSCKPT"
+FORMAT_VERSION = 2
+
+
+def _migrate_v1_to_v2(data: dict) -> dict:
+    """v2 introduced the versioned header; the payload itself is
+    unchanged, so the migration is the identity on the decoded dict."""
+    return data
+
+
+MIGRATIONS = {1: _migrate_v1_to_v2}
+
+
 # ---------------------------------------------------------------- API
 
 
@@ -285,25 +325,59 @@ def state_encode(rt) -> bytes:
 
 
 def state_hash(rt) -> str:
-    """Deterministic hex digest of the full chain state."""
+    """Deterministic hex digest of the full chain state (the payload
+    only — the replay-determinism anchor is header-independent)."""
     return hashlib.sha256(state_encode(rt)).hexdigest()
 
 
 def snapshot(rt) -> bytes:
-    """Serialized chain state (the ExportState role) — the canonical
-    encoding itself, so sha256(snapshot(rt)) == state_hash(rt)."""
-    return state_encode(rt)
+    """Serialized chain state (the ExportState role): versioned header
+    over the canonical encoding."""
+    return snapshot_and_hash(rt)[0]
 
 
-def restore(rt, blob: bytes) -> None:
-    """Load a snapshot into a freshly constructed runtime (same genesis
-    config).  Wiring (pallet cross-refs, verifiers, backend) stays as the
-    fresh construction made it; only data state is replaced.  The blob is
-    parsed by the canonical decoder — malformed input raises ValueError,
-    nothing in the format can execute code."""
+def snapshot_and_hash(rt) -> tuple[bytes, str]:
+    """One encoding pass for callers that need both the blob and the
+    state hash (the node service snapshots every committed block)."""
+    payload = state_encode(rt)
+    header = MAGIC + FORMAT_VERSION.to_bytes(2, "big")
+    return header + payload, hashlib.sha256(payload).hexdigest()
+
+
+def decode_blob(blob: bytes) -> tuple[int, dict]:
+    """Parse a snapshot blob → (version, payload dict), migrations NOT
+    yet applied.  Headerless blobs are v1 (the pre-header format)."""
+    version = 1
+    if blob.startswith(MAGIC):
+        version = int.from_bytes(blob[len(MAGIC):len(MAGIC) + 2], "big")
+        blob = blob[len(MAGIC) + 2:]
     reader = _Reader(blob, _dataclass_registry())
     data = reader.read()
     if reader.off != len(blob):
         raise ValueError("trailing bytes in snapshot")
+    if not isinstance(data, dict):
+        raise ValueError("snapshot payload is not a state mapping")
+    return version, data
+
+
+def restore(rt, blob: bytes) -> None:
+    """Load a snapshot into a freshly constructed runtime (same genesis
+    config), upgrading older format versions through MIGRATIONS.
+    Wiring (pallet cross-refs, verifiers, backend) stays as the fresh
+    construction made it; only data state is replaced.  The blob is
+    parsed by the canonical decoder — malformed input raises ValueError,
+    nothing in the format can execute code."""
+    version, data = decode_blob(blob)
+    if version > FORMAT_VERSION:
+        raise ValueError(
+            f"snapshot format v{version} is newer than this build "
+            f"(v{FORMAT_VERSION})"
+        )
+    while version < FORMAT_VERSION:
+        migrate = MIGRATIONS.get(version)
+        if migrate is None:
+            raise ValueError(f"no migration from snapshot format v{version}")
+        data = migrate(data)
+        version += 1
     for name, fields in data.items():
         _apply(getattr(rt, name), fields)

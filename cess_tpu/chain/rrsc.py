@@ -16,9 +16,13 @@ This pallet re-expresses both against the framework's deterministic
 block loop: `rotate_epoch` runs the credit-weighted election
 (staking.elect × scheduler_credit.credits) and refreshes the epoch
 randomness; `slot_author` deterministically draws the block author from
-the active set, stake-weighted, from (epoch randomness, slot).  Real
-networking/finality remain out of scope (chain/node.py simulates the
-multi-role protocol in-process).
+the active set, stake-weighted, from (epoch randomness, slot).  The
+draw depends only on on-chain state, so every replica computes the
+same author for a slot — node/sync.py's import verification leans on
+this (`author == slot_author(block.slot)` evaluated against the parent
+state), and node/service.py's wall-clock slot loop turns it into a
+live rotating-authorship network; chain/node.py still simulates the
+multi-role protocol in-process for tests.
 """
 
 from __future__ import annotations

@@ -131,6 +131,11 @@ class Runtime:
                 self.state.balances.mint(v, stake - free)
             self.staking.bond(v, v, stake)
             self.staking.add_validator(v)
+        # Genesis authorities are also the audit quorum keys (the
+        # session-keys genesis role) so a live chain's offchain workers
+        # can vote challenges from block 1 without a harness call.
+        if cfg.genesis_validators:
+            self.audit.initialize_keys(list(cfg.genesis_validators))
 
         # Root-dispatchable scheduler agenda targets.
         self._dispatch = {

@@ -136,6 +136,11 @@ class ChainState:
         self.events: list[Event] = []
         self.balances = Balances(self)
         self.agenda = Agenda()
+        # Consensus account nonces (frame_system::AccountInfo.nonce role):
+        # advanced only by block application, so every replica agrees and
+        # a signed extrinsic can never be replayed into a later block.
+        # Distinct from the node-local pool-intake high-water marks.
+        self.nonces: dict[str, int] = {}
         # Per-block shared randomness (parent-block randomness in the
         # reference, supplied by RRSC — reference: runtime/src/lib.rs:1003).
         self.randomness: bytes = bytes(32)

@@ -1,23 +1,36 @@
 """Node service layer (L5/L6): chain-spec genesis, signed-extrinsic
-dispatch, block production, JSON-RPC over TCP, role clients, CLI.
+dispatch, block production, block sync + BLS-aggregate finality,
+JSON-RPC over TCP, role clients, CLI.
 
 Re-design of the reference node (reference: node/src/{service,rpc,cli,
-command,chain_spec}.rs): the consensus-networking stack (libp2p, GRANDPA
-gossip) is replaced by a deterministic single-authoring service whose
-INTERFACES match — signed extrinsics into a pool, slot-driven block
-production with the RRSC author schedule, an RPC surface for state
-queries and submission, and separate role processes speaking RPC — while
-the data-plane heavy lifting stays on the TPU backends (proof/)."""
+command,chain_spec}.rs): the consensus-networking stack (libp2p,
+GRANDPA gossip) is re-expressed over the newline-JSON-RPC wire —
+signed extrinsics into a gossiped pool, wall-clock slot production
+with the RRSC author schedule, author-signed blocks announced and
+deterministically re-executed at import (sync.py), 2/3 BLS-aggregate
+justifications finalizing the chain, checkpoint warp-sync for
+rejoining nodes, and separate role processes speaking RPC — while the
+data-plane heavy lifting stays on the TPU backends (proof/)."""
 
 from .chain_spec import ChainSpec, dev_spec, local_spec
 from .client import MinerClient, RpcClient, TeeClient, UserClient
 from .metrics import REGISTRY, Counter, Gauge, Histogram, Registry
 from .rpc import RpcServer
 from .service import Extrinsic, NodeService, TxPool
+from .sync import (
+    Block,
+    BlockImportError,
+    Justification,
+    SyncGap,
+    SyncManager,
+    Vote,
+)
 
 __all__ = [
     "ChainSpec", "dev_spec", "local_spec",
     "RpcClient", "MinerClient", "TeeClient", "UserClient",
     "REGISTRY", "Counter", "Gauge", "Histogram", "Registry",
     "RpcServer", "Extrinsic", "NodeService", "TxPool",
+    "Block", "BlockImportError", "Justification", "SyncGap",
+    "SyncManager", "Vote",
 ]

@@ -54,6 +54,10 @@ class ChainSpec:
     name: str
     chain_id: str
     block_time_ms: int = 6000  # reference: 6 s blocks (runtime lib.rs:234)
+    # Finality vote cadence in blocks (the GRANDPA session-period role):
+    # validators vote for the canonical block at every multiple of this;
+    # 0 disables the voter (node/sync.py).
+    finality_period: int = 8
     genesis: dict[str, Any] = field(default_factory=dict)
     # account → {"balance": int, "pub": hex BLS public key}
     accounts: dict[str, dict[str, Any]] = field(default_factory=dict)
@@ -69,6 +73,7 @@ class ChainSpec:
                 "name": self.name,
                 "id": self.chain_id,
                 "blockTimeMs": self.block_time_ms,
+                "finalityPeriod": self.finality_period,
                 "genesis": self.genesis,
                 "accounts": self.accounts,
                 "validators": self.validators,
@@ -89,6 +94,7 @@ class ChainSpec:
             name=d["name"],
             chain_id=d["id"],
             block_time_ms=d.get("blockTimeMs", 6000),
+            finality_period=d.get("finalityPeriod", 8),
             genesis=d.get("genesis", {}),
             accounts=d.get("accounts", {}),
             validators=d.get("validators", []),

@@ -21,23 +21,41 @@ import sys
 import time
 
 
+def _parse_peers(arg: str) -> list[tuple[str, int]]:
+    """--peers host:port,host:port → [(host, port), …]."""
+    peers = []
+    for part in filter(None, (p.strip() for p in arg.split(","))):
+        host, _, port = part.rpartition(":")
+        peers.append((host or "127.0.0.1", int(port)))
+    return peers
+
+
 def _cmd_run(args) -> int:
     from .chain_spec import load_spec
     from .rpc import RpcServer
     from .service import NodeService
+    from .sync import SyncManager
 
     spec = load_spec(args.chain)
     if args.block_time_ms:
         spec.block_time_ms = args.block_time_ms
+    if args.finality_period is not None:
+        spec.finality_period = args.finality_period
     service = NodeService(spec, authority=args.authority)
     if args.import_state:
         with open(args.import_state, "rb") as fh:
             service.import_state(fh.read())
+    if args.peers:
+        SyncManager(
+            service, _parse_peers(args.peers),
+            checkpoint_gap=args.checkpoint_gap,
+        )
     server = RpcServer(service, host=args.rpc_host, port=args.rpc_port)
     server.start()
     print(
         f"cess-tpu-node: chain={spec.chain_id} rpc={server.host}:{server.port}"
-        f" block_time={spec.block_time_ms}ms",
+        f" block_time={spec.block_time_ms}ms"
+        f" peers={len(service.sync.peers) if service.sync else 0}",
         flush=True,
     )
     service.start()
@@ -52,9 +70,12 @@ def _cmd_run(args) -> int:
         pass
     finally:
         service.stop()
+        if service.sync is not None:
+            service.sync.stop()
         server.stop()
     print(
         f"stopped at block {service.rt.state.block_number} "
+        f"finalized={service.finalized_number} "
         f"state={service.state_hash()[:16]}…",
         flush=True,
     )
@@ -136,6 +157,14 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--block-time-ms", type=int, default=0)
     run.add_argument("--import-state", default=None,
                      help="checkpoint blob to resume from")
+    run.add_argument("--peers", default="",
+                     help="comma-separated host:port RPC endpoints of "
+                          "peer nodes (enables sync + finality gossip)")
+    run.add_argument("--finality-period", type=int, default=None,
+                     help="vote cadence in blocks (overrides spec)")
+    run.add_argument("--checkpoint-gap", type=int, default=64,
+                     help="catch-up gap above which a node bootstraps "
+                          "from a peer checkpoint instead of replaying")
     run.set_defaults(fn=_cmd_run)
 
     bs = sub.add_parser("build-spec", help="print a chain spec")

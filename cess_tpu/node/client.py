@@ -10,6 +10,7 @@ methods — they never touch the Runtime in-process."""
 
 from __future__ import annotations
 
+import hashlib
 import json
 import socket
 import time
@@ -18,6 +19,30 @@ from ..ops import bls12_381 as bls
 from .chain_spec import dev_sk
 from .rpc import RpcError
 from .service import Extrinsic
+
+
+def proof_commitment(items) -> bytes:
+    """≤ SigmaMax on-chain blob binding every (name, proof) of an
+    offchain-delivered proof set (the NodeSim._blob convention): the
+    chain carries the digest, the TEE checks the delivered proofs hash
+    to it before verifying."""
+    h = hashlib.sha256()
+    for name, _, proof in items:
+        h.update(name)
+        h.update(proof.commitment())
+    return h.digest()
+
+
+def challenge_from_snapshot(snap: dict):
+    """RPC `audit_challengeSnapshot` view → ops/podr2.Challenge (the
+    index/coefficient pairs miners prove against)."""
+    from ..ops.podr2 import Challenge
+
+    net = snap["net_snap_shot"]
+    return Challenge(
+        indices=tuple(int(i) for i in net["random_index_list"]),
+        randoms=tuple(bytes.fromhex(r["hex"]) for r in net["random_list"]),
+    )
 
 
 class RpcClient:
@@ -67,6 +92,7 @@ class SigningClient(RpcClient):
                  chain_id: str = "dev", **kw):
         super().__init__(**kw)
         self.account = account
+        self.chain_id = chain_id
         self.sk = sk if sk is not None else dev_sk(account, chain_id)
         # the node's genesis hash binds signatures to this chain
         self.genesis = self.call("system_chainGenesis")
@@ -92,7 +118,16 @@ class SigningClient(RpcClient):
 
 
 class MinerClient(SigningClient):
-    """Storage-miner role (reference: the external miner binary)."""
+    """Storage-miner role (reference: the external miner binary).  The
+    audit-round methods make the client a self-contained protocol actor:
+    it keeps its stored fillers/fragments locally (miner disks are not
+    chain state) and answers live challenges it observes over RPC."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        # name bytes → (tags, data): this miner's offchain store
+        self.idle_store: dict[bytes, tuple[list, bytes]] = {}
+        self.service_store: dict[bytes, tuple[list, bytes]] = {}
 
     def register(self, beneficiary: str, peer_id: bytes, stake: int) -> str:
         return self.submit(
@@ -102,26 +137,106 @@ class MinerClient(SigningClient):
     def upload_fillers(self, tee: str, filler_hashes: list[str]) -> str:
         return self.submit("file_bank", "upload_filler", tee, filler_hashes)
 
+    def create_fillers(self, tee: "TeeClient", count: int, params) -> str:
+        """Generate TEE-tagged filler fragments, keep them in the local
+        store, and report them on-chain (file-bank upload_filler — this
+        is what gives the miner auditable idle space)."""
+        from ..ops import podr2
+        from ..utils.hashing import Hash64
+
+        hashes = []
+        for _ in range(count):
+            seq = len(self.idle_store)
+            fh = Hash64.of(f"filler/{self.account}/{seq}".encode())
+            data = podr2.filler_data(fh.raw(), params)
+            name = fh.ascii_bytes()
+            tags = tee.tag_fragment(name, data, params)
+            self.idle_store[name] = (tags, data)
+            hashes.append(str(fh))
+        return self.upload_fillers(tee.account, hashes)
+
     def submit_proof(self, idle_prove: bytes, service_prove: bytes) -> str:
         return self.submit(
             "audit", "submit_proof",
             {"hex": idle_prove.hex()}, {"hex": service_prove.hex()},
         )
 
+    def _prove_store(self, store, challenge, backend, params):
+        from ..proof.backend import ProveRequest
+
+        if not store:
+            return []
+        names = sorted(store)
+        req = ProveRequest(
+            names=names,
+            tags=[store[n][0] for n in names],
+            data=[store[n][1] for n in names],
+            challenge=challenge,
+            params=params,
+        )
+        proofs = backend.prove_batch(req)
+        return [(n, challenge, p) for n, p in zip(names, proofs)]
+
+    def answer_challenge(self, backend, params):
+        """If the current on-chain challenge names this miner, prove
+        everything stored and submit the binding commitments (audit
+        submit_proof).  Returns (idle_items, service_items) for offchain
+        delivery to the verifying TEE, else None."""
+        snap = self.call("audit_challengeSnapshot")
+        if snap is None:
+            return None
+        if not any(
+            s["miner"] == self.account for s in snap["miner_snapshot_list"]
+        ):
+            return None
+        challenge = challenge_from_snapshot(snap)
+        idle_items = self._prove_store(
+            self.idle_store, challenge, backend, params)
+        service_items = self._prove_store(
+            self.service_store, challenge, backend, params)
+        self.submit_proof(
+            proof_commitment(idle_items), proof_commitment(service_items)
+        )
+        return idle_items, service_items
+
     def info(self) -> dict:
         return self.call("sminer_minerInfo", self.account)
 
 
 class TeeClient(SigningClient):
-    """TEE-worker role (reference: the external SGX worker)."""
+    """TEE-worker role (reference: the external SGX worker).  Holds the
+    PoDR2 tagging secret and its BLS node key client-side — the enclave
+    boundary: the chain only ever sees public keys and signed verdicts."""
 
-    def register(self, stash: str, node_key: bytes, peer: bytes,
-                 podr2_pbk: bytes, attestation: dict) -> str:
+    def __init__(self, *a, podr2_seed: bytes = b"", **kw):
+        super().__init__(*a, **kw)
+        from ..ops import podr2
+
+        seed = podr2_seed or f"tee:{self.account}".encode()
+        self.podr2_sk, self.podr2_pk = podr2.keygen(seed)
+        self.node_sk = bls.keygen(b"node:" + seed)
+        self.node_key = bls.sk_to_pk(self.node_sk)
+
+    def register(self, stash: str, node_key: bytes | None = None,
+                 peer: bytes = b"tee-peer", podr2_pbk: bytes | None = None,
+                 attestation: dict | None = None) -> str:
+        if node_key is None:
+            node_key = self.node_key
+        if podr2_pbk is None:
+            podr2_pbk = self.podr2_pk
+        if attestation is None:
+            attestation = make_dev_attestation(podr2_pbk, self.chain_id)
         return self.submit(
             "tee_worker", "register", stash,
             {"hex": node_key.hex()}, {"hex": peer.hex()},
             {"hex": podr2_pbk.hex()}, attestation,
         )
+
+    def tag_fragment(self, name: bytes, data: bytes, params) -> list:
+        """Calculate-stage tagging (the enclave's PoDR2 signing role)."""
+        from ..ops import podr2
+
+        return podr2.tag_fragment(self.podr2_sk, name, data, params)
 
     def submit_verdict(self, miner: str, idle_ok: bool, service_ok: bool,
                        signature: bytes = b"") -> str:
@@ -129,6 +244,43 @@ class TeeClient(SigningClient):
             "audit", "submit_verify_result", miner, idle_ok, service_ok,
             {"hex": signature.hex()},
         )
+
+    def verify_missions(self, backend, params, delivered: dict,
+                        seed: bytes = b"live-audit") -> dict:
+        """Drain this TEE's verify missions (audit_unverifyProof view):
+        check each miner's offchain-delivered proofs against the on-chain
+        commitment, batch-verify through the ProofBackend, and submit the
+        node-key-signed verdict.  Returns {miner: (idle_ok, service_ok)}."""
+        from ..chain.audit import AuditPallet
+
+        results = {}
+        for mission in self.call("audit_unverifyProof", self.account):
+            miner = mission["snap_shot"]["miner"]
+            if miner not in delivered:
+                continue
+            idle_items, service_items = delivered[miner]
+            idle_ok = (
+                bytes.fromhex(mission["idle_prove"]["hex"])
+                == proof_commitment(idle_items)
+            )
+            service_ok = (
+                bytes.fromhex(mission["service_prove"]["hex"])
+                == proof_commitment(service_items)
+            )
+            idle_ok = idle_ok and all(
+                backend.verify_batch(self.podr2_pk, idle_items, seed, params)
+            )
+            service_ok = service_ok and all(
+                backend.verify_batch(
+                    self.podr2_pk, service_items, seed, params)
+            )
+            sig = bls.sign(
+                self.node_sk,
+                AuditPallet.result_message(miner, idle_ok, service_ok),
+            )
+            self.submit_verdict(miner, idle_ok, service_ok, sig)
+            results[miner] = (idle_ok, service_ok)
+        return results
 
 
 class UserClient(SigningClient):

@@ -74,7 +74,8 @@ class RpcApi:
         @method("system_health")
         def _health():
             return {
-                "peers": 0, "isSyncing": False,
+                "peers": len(s.sync.peers) if s.sync is not None else 0,
+                "isSyncing": False,
                 "shouldHavePeers": len(s.spec.validators) > 1,
                 "txpool": len(s.pool),
             }
@@ -119,6 +120,17 @@ class RpcApi:
             except (ValueError, KeyError) as e:
                 raise RpcError(-32010, str(e))
 
+        @method("author_gossipExtrinsic")
+        def _gossip(ext: dict):
+            """Peer-pool intake: like author_submitExtrinsic but never
+            re-broadcast (fully-connected mesh, no relay loops).  Nonce
+            or duplicate mismatches are expected races, not errors."""
+            try:
+                return s.submit_extrinsic(
+                    Extrinsic.from_json(ext), gossip=False)
+            except (ValueError, KeyError) as e:
+                return f"dropped: {e}"
+
         @method("author_pendingExtrinsics")
         def _pending():
             return len(s.pool)
@@ -142,6 +154,10 @@ class RpcApi:
         @method("sminer_allMiners")
         def _miners():
             return s.rt.sminer.get_all_miner()
+
+        @method("sminer_rewardInfo")
+        def _reward(account: str):
+            return _view(s.rt.sminer.reward_map.get(account))
 
         @method("audit_challengeSnapshot")
         def _chal():
@@ -254,6 +270,109 @@ class RpcApi:
             if not res.success:
                 raise RpcError(-32015, f"execution reverted: {res.error}")
             return hex(res.gas_used + G_TX)
+
+        # ---- sync + finality (node/sync.py wire surface: the block
+        # announce/request protocols and GRANDPA gossip of the reference,
+        # service.rs:219-584)
+        from .sync import (
+            SYNC_PROTO_VERSION, Block, BlockImportError, Justification,
+            Vote,
+        )
+
+        @method("sync_status")
+        def _sync_status():
+            return {
+                "version": SYNC_PROTO_VERSION,
+                "genesis": s.genesis,
+                "number": s.rt.state.block_number,
+                "hash": s.head_hash,
+                "slot": s.slot,
+                "finalized": {
+                    "number": s.finalized_number, "hash": s.finalized_hash,
+                },
+            }
+
+        @method("sync_announce")
+        def _sync_announce(block: dict):
+            try:
+                return s.handle_announce(block)
+            except BlockImportError as e:
+                raise RpcError(-32020, str(e))
+
+        @method("sync_block")
+        def _sync_block(number: int):
+            blk = s.block_by_number.get(int(number))
+            if blk is None:
+                raise RpcError(-32004, "block not held")
+            just = s.justifications.get(int(number))
+            return {
+                "block": blk.to_json(),
+                "justification": None if just is None else just.to_json(),
+            }
+
+        @method("sync_checkpoint")
+        def _sync_checkpoint():
+            # Serve the FINALIZED anchor: a warp blob is only trusted by
+            # the receiver when covered by a 2/3 justification, so the
+            # post-state blob / head block / justification triple must
+            # all be for the same finalized height.  Catch-up replays
+            # the rest of the chain block by block.
+            with s._lock:
+                number = s.finalized_number
+                head = s.block_by_number.get(s.finalized_number)
+                just = s.justifications.get(s.finalized_number)
+                blob = None
+                if head is not None and just is not None:
+                    bh = head.hash(s.genesis)
+                    if bh == s.finalized_hash:
+                        blob = s._state_blobs.get(bh)
+                if blob is None:
+                    # nothing finalized (or blob evicted): the receiver
+                    # will reject an unjustified anchor and fall back to
+                    # block replay
+                    number = s.rt.state.block_number
+                    head = s.block_store.get(s.head_hash)
+                    just = None
+                    blob = s.export_state()
+                return {
+                    "number": number,
+                    "blob": blob.hex(),
+                    "head": None if head is None else head.to_json(),
+                    "justification": (
+                        None if just is None else just.to_json()
+                    ),
+                }
+
+        @method("sync_vote")
+        def _sync_vote(vote: dict):
+            try:
+                return s.add_vote(Vote.from_json(vote))
+            except (KeyError, TypeError, ValueError) as e:
+                raise RpcError(-32021, f"malformed vote: {e!r}")
+
+        @method("sync_justification")
+        def _sync_just(just: dict):
+            try:
+                return s.handle_justification(Justification.from_json(just))
+            except (KeyError, TypeError, ValueError) as e:
+                raise RpcError(-32022, f"malformed justification: {e!r}")
+
+        @method("chain_finalized_head")
+        def _finalized():
+            return {"number": s.finalized_number, "hash": s.finalized_hash}
+
+        # ---- audit offchain views (what the miner/TEE role clients
+        # poll to drive a live audit round)
+        @method("audit_unverifyProof")
+        def _unverify(tee: str):
+            return _view(s.rt.audit.unverify_proof.get(tee, []))
+
+        @method("audit_challengeDuration")
+        def _chal_duration():
+            return {
+                "challenge": s.rt.audit.challenge_duration,
+                "verify": s.rt.audit.verify_duration,
+            }
 
         # ---- dev helpers
         @method("dev_produceBlock")

@@ -1,31 +1,51 @@
-"""Node service: signed-extrinsic pool → slot-driven block production.
+"""Node service: signed-extrinsic pool → slot-driven block production,
+block import, and BLS-aggregate finality.
 
 Role match: the reference's service assembly (reference:
 node/src/service.rs:219-584 — tx pool, import queue, RRSC authoring
-loop) collapsed onto the deterministic Runtime: extrinsics are
-BLS-signed, nonce-ordered, verified at intake (the pool's validation
-role), and applied in block order after on_initialize, with per-block
-receipts as the event record.  The RRSC stand-in (chain/rrsc.py) picks
-the slot author from a monotone slot counter; a service configured with
-an authority key authors only its own slots and skips the rest (block
-import/gossip for the skipped slots is out of scope — multi-validator
-chains need every validator's extrinsics submitted to every node, the
-replicated-state-machine discipline, not a network sync)."""
+loop, GRANDPA voter) collapsed onto the deterministic Runtime:
+extrinsics are BLS-signed, nonce-ordered, verified at intake (the
+pool's validation role), and applied in block order after
+on_initialize, with per-block receipts as the event record.  The RRSC
+stand-in (chain/rrsc.py) picks the slot author from a monotone slot
+counter; a service configured with an authority key authors only its
+own slots.
+
+Authored blocks carry the author's BLS signature over (parent hash,
+slot, extrinsic root, post-state hash) and are announced to peers via
+the attached node/sync.py SyncManager; `import_block` re-executes peer
+blocks deterministically and rejects wrong-author, bad-signature, or
+state-hash-mismatched blocks.  Every `finality_period` blocks the
+validator signs the canonical head; 2/3 BLS-aggregate justifications
+finalize it (the GRANDPA-gadget role).  The slot hook also runs the
+audit offchain worker for this node's authority and submits resulting
+extrinsics through its own pool, so a CLI-launched chain completes
+audit rounds with no external driver."""
 
 from __future__ import annotations
 
 import hashlib
-import json
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 
 from ..chain.runtime import Runtime
 from ..chain.types import DispatchError
 from ..chain import checkpoint
 from ..ops import bls12_381 as bls
-from .chain_spec import ChainSpec
+from .chain_spec import ChainSpec, dev_sk
+from .sync import (
+    Block,
+    BlockImportError,
+    Justification,
+    SyncGap,
+    Vote,
+    canonical_json,
+    finality_payload,
+    quorum,
+    verify_justification,
+)
 from . import metrics as m
 
 
@@ -45,11 +65,13 @@ class Extrinsic:
     signature: str = ""  # hex BLS signature over payload()
 
     def payload(self, genesis: str) -> bytes:
-        return json.dumps(
+        # sync.canonical_json is THE consensus byte encoding — block
+        # signing payloads embed hashes of these bytes, so the two
+        # must never diverge
+        return canonical_json(
             [genesis, self.signer, self.module, self.call, self.args,
-             self.nonce],
-            sort_keys=True, separators=(",", ":"),
-        ).encode()
+             self.nonce]
+        )
 
     def sign(self, sk: int, genesis: str) -> "Extrinsic":
         self.signature = bls.sign(sk, self.payload(genesis)).hex()
@@ -131,8 +153,69 @@ def _adapt_upload_filler(rt, sender, args):
     from ..utils.hashing import Hash64
 
     tee, fillers = args
-    infos = [FillerInfo(filler_hash=Hash64(f)) for f in fillers]
+    infos = [
+        FillerInfo(
+            block_num=rt.state.block_number,
+            miner_address=sender,
+            filler_hash=Hash64(f),
+        )
+        for f in fillers
+    ]
     rt.file_bank.upload_filler(sender, tee, infos)
+
+
+def challenge_info_to_json(info) -> dict:
+    """ChallengeInfo → JSON extrinsic argument (the OCW's unsigned
+    challenge-vote payload, reference: audit lib.rs:364-416).  Every
+    validator derives the identical info from shared randomness, so the
+    canonical JSON round-trips to the identical proposal hash."""
+    net = info.net_snap_shot
+    return {
+        "net": {
+            "start": net.start, "life": net.life,
+            "totalReward": net.total_reward,
+            "totalIdle": net.total_idle_space,
+            "totalService": net.total_service_space,
+            "indexList": list(net.random_index_list),
+            "randomList": [r.hex() for r in net.random_list],
+        },
+        "miners": [
+            {"miner": s.miner, "idle": s.idle_space, "service": s.service_space}
+            for s in info.miner_snapshot_list
+        ],
+    }
+
+
+def challenge_info_from_json(d: dict):
+    from ..chain.audit import ChallengeInfo, MinerSnapShot, NetSnapShot
+
+    net = d["net"]
+    return ChallengeInfo(
+        net_snap_shot=NetSnapShot(
+            start=int(net["start"]), life=int(net["life"]),
+            total_reward=int(net["totalReward"]),
+            total_idle_space=int(net["totalIdle"]),
+            total_service_space=int(net["totalService"]),
+            random_index_list=[int(i) for i in net["indexList"]],
+            random_list=[bytes.fromhex(r) for r in net["randomList"]],
+        ),
+        miner_snapshot_list=[
+            MinerSnapShot(
+                miner=s["miner"], idle_space=int(s["idle"]),
+                service_space=int(s["service"]),
+            )
+            for s in d["miners"]
+        ],
+    )
+
+
+def _adapt_save_challenge(rt, sender, args):
+    """Challenge vote intake: the validate_unsigned + call seam
+    (reference: audit lib.rs:540-556).  `save_challenge_info` itself
+    enforces authority membership and the per-key replay guard."""
+    rt.audit.save_challenge_info(
+        challenge_info_from_json(args[0]), sender, signature=None
+    )
 
 
 # Callable extrinsics: (module, call) → adapter (None = generic
@@ -170,6 +253,7 @@ EXTRINSIC_DISPATCH: dict = {
     **{("audit", c): None for c in (
         "submit_proof", "submit_verify_result",
     )},
+    ("audit", "save_challenge_info"): _adapt_save_challenge,
     # pallet_evm call/create/deposit/withdraw role (reference:
     # runtime/src/lib.rs:1322-1344)
     **{("evm", c): None for c in ("deposit", "withdraw")},
@@ -211,6 +295,33 @@ class TxPool:
                 out.append(self._ready.popleft())
             return out
 
+    def requeue(self, exts: list[Extrinsic], genesis: str) -> None:
+        """Put retracted-block extrinsics back at the FRONT of the pool
+        (the reorg path: a dropped block's transactions return to the
+        pool, as the reference's pool does on retraction).  Bypasses the
+        duplicate guard — these hashes were seen at original intake —
+        but skips anything already queued."""
+        with self._lock:
+            queued = {e.hash(genesis) for e in self._ready}
+            for ext in reversed(exts):
+                h = ext.hash(genesis)
+                if h in queued:
+                    continue
+                self._seen.add(h)
+                self._ready.appendleft(ext)
+                queued.add(h)
+
+    def prune(self, hashes: set[str], genesis: str) -> None:
+        """Drop queued extrinsics that just landed on chain via an
+        imported block (tx gossip means several pools hold the same
+        extrinsic; whoever authors first wins, the rest prune)."""
+        if not hashes:
+            return
+        with self._lock:
+            self._ready = deque(
+                e for e in self._ready if e.hash(genesis) not in hashes
+            )
+
     def __len__(self) -> int:
         return len(self._ready)
 
@@ -224,6 +335,16 @@ class BlockRecord:
     author: str
     extrinsics: list[str] = field(default_factory=list)
     receipts: list[dict] = field(default_factory=list)
+    hash: str = ""
+    imported: bool = False  # True when re-executed from a peer block
+
+
+# Recent post-state snapshots kept for head-reorg rollback and
+# state-mismatch recovery (the reference keeps the full chain DB; this
+# bounds memory on long-running nodes).  Exposed as a NodeService class
+# attribute so sync.py derives its fork-rewind window from it instead
+# of duplicating the number.
+STATE_CACHE_BLOCKS = 64
 
 
 class NodeService:
@@ -233,6 +354,7 @@ class NodeService:
     every slot — the single-node dev mode)."""
 
     MAX_EXTRINSICS_PER_BLOCK = 512
+    STATE_CACHE_BLOCKS = STATE_CACHE_BLOCKS
 
     def __init__(
         self,
@@ -265,6 +387,47 @@ class NodeService:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
+        # The identity this node signs as: blocks, finality votes, and
+        # the audit OCW's challenge votes.  A dedicated authority uses
+        # its own key; dev mode (authority=None) signs as the slot
+        # author, whose dev key is derivable from the spec seed.
+        self._ocw_identity = authority or (
+            spec.validators[0] if spec.validators else None
+        )
+        self.authority_sk: int | None = None
+        if self._ocw_identity is not None and spec.dev_seed:
+            self.authority_sk = dev_sk(self._ocw_identity, spec.chain_id)
+
+        # Block store + head anchor (the chain-DB role): parent of block
+        # #1 is the genesis spec hash; recent post-state blobs allow
+        # head-reorg rollback and failed-import recovery.
+        self.head_hash = self.genesis
+        self.block_store: dict[str, Block] = {}
+        self.block_by_number: dict[int, Block] = {}
+        self._state_blobs: OrderedDict[str, bytes] = OrderedDict()
+        self._state_blobs[self.genesis] = checkpoint.snapshot(self.rt)
+
+        # Finality (node/sync.py GRANDPA stand-in): collected votes per
+        # (number, hash), targets this node already voted, and accepted
+        # justifications by number.
+        self.finalized_number = 0
+        self.finalized_hash = self.genesis
+        self._votes: dict[tuple[int, str], dict[str, str]] = {}
+        self._voted: set[int] = set()
+        # Equivocation bookkeeping: which hash each voter signed per
+        # height, and voters proven to have signed two hashes at one
+        # height (their weight counts for NEITHER fork — one Byzantine
+        # validator must not be able to complete conflicting 2/3
+        # quorums on different replicas).
+        self._vote_hash: dict[int, dict[str, str]] = {}
+        self._equivocators: dict[int, set[str]] = {}
+        self.justifications: dict[int, Justification] = {}
+        # Verified justifications whose target block we have not
+        # imported yet (gossip often outruns the ~0.4s import path);
+        # retried as soon as the block at that height lands.
+        self._pending_justs: dict[int, Justification] = {}
+        self.sync = None  # node/sync.py SyncManager, via attach_sync()
+
         # Per-service registry by default: two services in one process
         # must not collide on metric names in the global REGISTRY.
         reg = registry if registry is not None else m.Registry()
@@ -277,20 +440,41 @@ class NodeService:
         self.m_pool = m.Gauge("cess_txpool_ready", "pool depth", reg)
         self.m_block_time = m.Histogram(
             "cess_block_seconds", "block production time", registry=reg)
+        self.m_imported = m.Counter(
+            "cess_blocks_imported", "peer blocks imported", reg)
+        self.m_import_rejected = m.Counter(
+            "cess_blocks_rejected", "peer blocks failing verification", reg)
+        self.m_reorgs = m.Counter(
+            "cess_reorgs", "head reorgs (same-height fork choice)", reg)
+        self.m_finalized = m.Gauge(
+            "cess_finalized_number", "latest finalized block", reg)
+        self.m_votes = m.Counter(
+            "cess_finality_votes", "finality votes accepted", reg)
+        self.m_catchup = m.Counter(
+            "cess_catchup_runs", "checkpoint bootstraps during catch-up",
+            reg)
         self.registry = reg
 
     # ------------------------------------------------------ submission
 
-    def submit_extrinsic(self, ext: Extrinsic) -> str:
+    def submit_extrinsic(self, ext: Extrinsic, gossip: bool = True,
+                         _verified: bool = False) -> str:
         """Pool intake: signature + nonce + whitelist validation (the
-        validate_transaction role)."""
+        validate_transaction role).  Accepted extrinsics gossip to every
+        peer pool (`gossip=False` marks peer-received copies, which are
+        not re-broadcast — the mesh is fully connected), so whichever
+        validator authors next can include them even if this node's own
+        blocks keep losing fork choice.  `_verified=True` skips the
+        pairing check for extrinsics this node signed itself moments ago
+        (the OCW path) — a full verify there burns most of a slot."""
         if (ext.module, ext.call) not in EXTRINSIC_DISPATCH:
             raise ValueError(f"unknown call {ext.module}::{ext.call}")
         pk = self.keys.get(ext.signer)
         if pk is None:
             raise ValueError(f"unknown signer {ext.signer}")
-        if not bls.verify(pk, ext.payload(self.genesis),
-                          bytes.fromhex(ext.signature)):
+        if not _verified and not bls.verify(
+            pk, ext.payload(self.genesis), bytes.fromhex(ext.signature)
+        ):
             raise ValueError("bad signature")
         # nonce check-and-increment under the service lock: concurrent
         # RPC threads must not both pass with the same nonce
@@ -301,6 +485,8 @@ class NodeService:
             self.nonces[ext.signer] = expected + 1
             h = self.pool.submit(ext, self.genesis)
         self.m_pool.set(len(self.pool))
+        if gossip and self.sync is not None:
+            self.sync.broadcast_extrinsic(ext)
         return h
 
     # ------------------------------------------------------ authoring
@@ -316,49 +502,645 @@ class NodeService:
                 pass
         return self.spec.validators[0] if self.spec.validators else "dev"
 
-    def produce_block(self) -> BlockRecord | None:
-        """One slot: on_initialize hooks, then apply pooled extrinsics.
-        Returns None when this node is not the slot author.  The slot
-        counter advances on EVERY call (authored or not), so an authority
-        node keeps reaching its own slots even while other validators own
-        the intervening ones."""
-        with self._lock, self.m_block_time.time():
-            self.slot += 1
-            author = self._slot_author(self.slot)
-            if self.authority is not None and author != self.authority:
-                return None
-            self.rt.run_blocks(1)
-            record = BlockRecord(number=self.rt.state.block_number, author=author)
-            for ext in self.pool.drain(self.MAX_EXTRINSICS_PER_BLOCK):
-                adapter = EXTRINSIC_DISPATCH.get((ext.module, ext.call))
-                receipt = {"hash": ext.hash(self.genesis), "ok": True}
-                try:
-                    if adapter is not None:
-                        adapter(self.rt, ext.signer, ext.args)
-                    else:
-                        pallet = getattr(self.rt, ext.module)
-                        fn = getattr(pallet, ext.call)
-                        fn(ext.signer, *[_decode_arg(a) for a in ext.args])
-                    self.m_ext_ok.inc()
-                except DispatchError as e:
-                    receipt = {**receipt, "ok": False, "error": str(e)}
-                    self.m_ext_err.inc()
-                except (TypeError, ValueError, KeyError, IndexError,
-                        AttributeError) as e:
-                    # malformed argument shapes (missing dict keys, wrong
-                    # arity, bad hex…) must not kill the authoring loop —
-                    # the extrinsic fails, the block goes on
-                    receipt = {
-                        **receipt, "ok": False,
-                        "error": f"invalid-call: {e!r}",
-                    }
-                    self.m_ext_err.inc()
+    def _apply_extrinsics(
+        self, exts: list[Extrinsic], record: BlockRecord
+    ) -> None:
+        """Apply a block body in order, recording receipts.  Shared by
+        authoring and import so replicas execute identically."""
+        for ext in exts:
+            adapter = EXTRINSIC_DISPATCH.get((ext.module, ext.call))
+            receipt = {"hash": ext.hash(self.genesis), "ok": True}
+            # Consensus replay gate: the nonce must match the CHAIN's
+            # account nonce (state.nonces, advanced only here), so a
+            # malicious author re-including an already-applied signed
+            # extrinsic produces a deterministic failed receipt on every
+            # replica instead of a double execution.
+            expected = self.rt.state.nonces.get(ext.signer, 0)
+            if ext.nonce != expected:
+                receipt = {
+                    **receipt, "ok": False,
+                    "error": f"stale nonce {ext.nonce} "
+                             f"(account is at {expected})",
+                }
+                self.m_ext_err.inc()
                 record.extrinsics.append(receipt["hash"])
                 record.receipts.append(receipt)
-            self.blocks.append(record)
+                continue
+            self.rt.state.nonces[ext.signer] = expected + 1
+            try:
+                if adapter is not None:
+                    adapter(self.rt, ext.signer, ext.args)
+                else:
+                    pallet = getattr(self.rt, ext.module)
+                    fn = getattr(pallet, ext.call)
+                    fn(ext.signer, *[_decode_arg(a) for a in ext.args])
+                self.m_ext_ok.inc()
+            except DispatchError as e:
+                receipt = {**receipt, "ok": False, "error": str(e)}
+                self.m_ext_err.inc()
+            except (TypeError, ValueError, KeyError, IndexError,
+                    AttributeError) as e:
+                # malformed argument shapes (missing dict keys, wrong
+                # arity, bad hex…) must not kill the authoring loop —
+                # the extrinsic fails, the block goes on
+                receipt = {
+                    **receipt, "ok": False,
+                    "error": f"invalid-call: {e!r}",
+                }
+                self.m_ext_err.inc()
+            record.extrinsics.append(receipt["hash"])
+            record.receipts.append(receipt)
+
+    def _author_sk(self, author: str) -> int | None:
+        """Secret key this node can sign the author's blocks with: its
+        own authority key, or (dev/local chains) the derivable seed key
+        when the service authors every slot."""
+        if author == self._ocw_identity and self.authority_sk is not None:
+            return self.authority_sk
+        if self.authority is None and self.spec.dev_seed:
+            return dev_sk(author, self.spec.chain_id)
+        return None
+
+    def _commit_block(
+        self, block: Block, record: BlockRecord, blob: bytes
+    ) -> None:
+        """Head bookkeeping after a block executed: store, cache the
+        post-state blob, advance the head anchor and slot clock."""
+        h = block.hash(self.genesis)
+        record.hash = h
+        self.block_store[h] = block
+        self.block_by_number[block.number] = block
+        self.head_hash = h
+        self.slot = max(self.slot, block.slot)
+        self._state_blobs[h] = blob
+        while len(self._state_blobs) > STATE_CACHE_BLOCKS:
+            self._state_blobs.popitem(last=False)
+        self.blocks.append(record)
+        self.m_pool.set(len(self.pool))
+
+    def produce_block(self, slot: int | None = None) -> BlockRecord | None:
+        """One slot: on_initialize hooks, then apply pooled extrinsics.
+        Returns None when this node is not the slot author.  Without an
+        explicit slot the counter advances by one per call (the
+        single-node/dev cadence); networked slot loops pass the
+        wall-clock slot so every replica agrees on who owns the current
+        slot — a slot at or below the head's is already settled and
+        skipped."""
+        with self._lock, self.m_block_time.time():
+            if slot is None:
+                self.slot += 1
+            else:
+                if slot <= self.slot:
+                    return None
+                self.slot = slot
+            author = self._slot_author(self.slot)
+            if self.authority is None and self.sync is not None:
+                # networked but keyless: observer/RPC full node.  The
+                # dev fallback below would sign with the slot owner's
+                # derived key — forging blocks under another
+                # validator's identity — so never author here.
+                return None
+            if self.authority is not None and author != self.authority:
+                return None
+            parent = self.head_hash
+            slot = self.slot
+            exts = self.pool.drain(self.MAX_EXTRINSICS_PER_BLOCK)
+            self.rt.run_blocks(1)
+            record = BlockRecord(
+                number=self.rt.state.block_number, author=author)
+            self._apply_extrinsics(exts, record)
+            blob, shash = checkpoint.snapshot_and_hash(self.rt)
+            block = Block(
+                number=record.number, slot=slot, parent=parent,
+                author=author, state_hash=shash,
+                extrinsics=[e.to_json() for e in exts],
+            )
+            sk = self._author_sk(author)
+            if sk is not None:
+                block.sign(sk, self.genesis)
+            self._commit_block(block, record, blob)
             self.m_blocks.inc()
+        # outside the lock: network fan-out + offchain hooks
+        if self.sync is not None:
+            self.sync.announce_block(block)
+        self._post_block(record.number)
+        return record
+
+    # ------------------------------------------------------ import
+
+    def head_number(self) -> int:
+        with self._lock:
+            return self.rt.state.block_number
+
+    def attach_sync(self, sync) -> None:
+        self.sync = sync
+
+    def _parent_slot(self, parent: str) -> int:
+        blk = self.block_store.get(parent)
+        return blk.slot if blk is not None else 0
+
+    def _requeue_retracted(self, blocks: list[Block]) -> None:
+        """Reorg aftercare: a retracted block's extrinsics go back into
+        the pool so they land on the winning chain in a later block
+        (the reference pool's retraction behavior) instead of vanishing."""
+        exts = []
+        for blk in blocks:
+            for d in blk.extrinsics:
+                try:
+                    exts.append(Extrinsic.from_json(d))
+                except (KeyError, TypeError, ValueError):
+                    continue
+        if exts:
+            self.pool.requeue(exts, self.genesis)
             self.m_pool.set(len(self.pool))
-            return record
+
+    def _rollback_head(self) -> tuple[Block, str, bytes, BlockRecord | None]:
+        """Drop the current head (same-height fork choice lost): restore
+        the parent post-state blob and rewind bookkeeping.  Pool nonces
+        are left at their high-water mark — intake gating is node-local,
+        never consensus state.  Returns everything needed to reinstate
+        the head if the replacement block then fails verification (the
+        fork choice must be transactional: an unverified announce must
+        never leave the node headless).  Checks the parent blob BEFORE
+        mutating anything, so failure leaves state untouched."""
+        head = self.block_store[self.head_hash]
+        parent_blob = self._state_blobs.get(head.parent)
+        if parent_blob is None:
+            raise BlockImportError("parent state evicted; cannot reorg")
+        head_hash = self.head_hash
+        head_blob = self._state_blobs.pop(head_hash)
+        self.block_store.pop(head_hash)
+        self.block_by_number.pop(head.number, None)
+        record = None
+        if self.blocks and self.blocks[-1].number == head.number:
+            record = self.blocks.pop()
+        checkpoint.restore(self.rt, parent_blob)
+        self.head_hash = head.parent
+        # NOTE: _voted deliberately keeps the retracted height.  A vote
+        # for the dead hash may already be part of a forming quorum;
+        # voting again for the replacement (equivocation) lets two
+        # conflicting justifications finalize the same height on
+        # different nodes — a permanent chain split.  The price is one
+        # possibly-lapsed boundary; the next period finalizes normally.
+        self._requeue_retracted([head])
+        self.m_reorgs.inc()
+        return head, head_hash, head_blob, record
+
+    def _reinstate_head(
+        self, head: Block, head_hash: str, head_blob: bytes,
+        record: BlockRecord | None,
+    ) -> None:
+        """Undo a _rollback_head after the competing block failed
+        verification: restore the old head's state and bookkeeping and
+        take its extrinsics back out of the pool."""
+        checkpoint.restore(self.rt, head_blob)
+        self.block_store[head_hash] = head
+        self.block_by_number[head.number] = head
+        self._state_blobs[head_hash] = head_blob
+        self.head_hash = head_hash
+        if record is not None:
+            self.blocks.append(record)
+            self.pool.prune(set(record.extrinsics), self.genesis)
+
+    def import_block(self, block: Block) -> BlockRecord | None:
+        """Verify and re-execute a peer block (the import-queue role).
+
+        Rejections (BlockImportError): unknown/wrong slot author, bad
+        author signature, non-monotone slot, invalid extrinsic
+        signatures, or a post-state hash that does not match our own
+        deterministic re-execution.  A block one past our head imports;
+        a same-height fork triggers fork choice (lower slot wins, then
+        lower hash — both replicas converge); anything further ahead
+        raises SyncGap for the caller to catch up.  Every rejection
+        bumps m_import_rejected exactly once."""
+        try:
+            return self._import_block_inner(block)
+        except BlockImportError:
+            self.m_import_rejected.inc()
+            raise
+
+    def _import_block_inner(self, block: Block) -> BlockRecord | None:
+        with self._lock:
+            try:
+                h = block.hash(self.genesis)
+            except ValueError:  # non-hex signature in the announce
+                raise BlockImportError("undecodable signature")
+            if h in self.block_store:
+                return None  # known
+            head_n = self.rt.state.block_number
+            undo = None
+            if block.number == head_n and head_n > self.finalized_number:
+                head = self.block_store.get(self.head_hash)
+                if head is None or block.parent != head.parent:
+                    return None  # unrelated fork; ignore
+                if (block.slot, h) >= (head.slot, self.head_hash):
+                    return None  # our head wins fork choice
+                # Authenticate BEFORE the destructive rollback: fork
+                # choice fields (number/slot/parent) are attacker-chosen,
+                # so an unverified announce must not be able to knock the
+                # genuine head off.  The full slot-author check still
+                # runs below against the parent state; this gate pins the
+                # claimed author to the validator set and to a signature
+                # under that validator's key.
+                self._check_author_signature(block)
+                undo = self._rollback_head()
+                head_n -= 1
+            author_verified = undo is not None
+            try:
+                if block.number <= head_n:
+                    return None  # stale
+                if block.number > head_n + 1:
+                    raise SyncGap(head_n, block.number)
+                if block.parent != self.head_hash:
+                    raise BlockImportError("unknown parent")
+                if block.slot <= self._parent_slot(block.parent):
+                    raise BlockImportError("non-monotone slot")
+                expected = self._slot_author(block.slot)
+                if block.author != expected:
+                    raise BlockImportError(
+                        f"wrong author: slot {block.slot} belongs to "
+                        f"{expected}"
+                    )
+                record = self._verify_and_apply(
+                    block, author_verified=author_verified)
+            except BlockImportError:
+                if undo is not None:
+                    self._reinstate_head(*undo)
+                raise
+            self._commit_block(block, record[0], record[1])
+            self.m_imported.inc()
+        self._post_block(block.number)
+        return record[0]
+
+    def _author_pk(self, block: Block) -> bytes:
+        """Structural author checks shared by every verification path:
+        the claimed author is a validator with a known key and the block
+        carries a signature at all."""
+        if block.author not in self.spec.validators:
+            raise BlockImportError("author is not a validator")
+        pk = self.keys.get(block.author)
+        if pk is None or not block.signature:
+            raise BlockImportError("unsigned block")
+        return pk
+
+    def _check_author_signature(self, block: Block) -> None:
+        """The state-independent part of block verification: the claimed
+        author is a validator and signed the header payload."""
+        pk = self._author_pk(block)
+        try:
+            sig = bytes.fromhex(block.signature)
+        except ValueError:
+            raise BlockImportError("undecodable signature")
+        if not bls.verify(pk, block.signing_payload(self.genesis), sig):
+            raise BlockImportError("bad author signature")
+
+    def _verify_and_apply(
+        self, block: Block, author_verified: bool = False
+    ) -> tuple[BlockRecord, bytes]:
+        """Signature aggregate + deterministic re-execution; rolls the
+        runtime back on a post-state mismatch.  Caller holds the lock.
+        `author_verified=True` (the fork-choice path, where
+        _check_author_signature already ran a full pairing) keeps the
+        block signature out of the aggregate instead of paying for it
+        twice."""
+        pk = self._author_pk(block)
+        try:
+            exts = [Extrinsic.from_json(e) for e in block.extrinsics]
+        except (KeyError, TypeError, ValueError) as e:
+            raise BlockImportError(f"malformed extrinsic: {e!r}")
+        # One aggregate pairing check covers the author's block
+        # signature and every extrinsic signature (1 + #keys Miller
+        # loops instead of 2 per signature).  Sound because every
+        # payload is distinct — the block payload by shape, the
+        # extrinsic payloads by embedded (signer, nonce) — which the
+        # duplicate check enforces against a malicious author.
+        from ..ops import bls_agg
+
+        msgs: list[bytes] = []
+        pks: list[bytes] = []
+        raw_sigs: list[str] = []
+        seen_payloads = {block.signing_payload(self.genesis)}
+        if not author_verified:
+            msgs.append(block.signing_payload(self.genesis))
+            pks.append(pk)
+            raw_sigs.append(block.signature)
+        for ext in exts:
+            epk = self.keys.get(ext.signer)
+            if epk is None or not ext.signature:
+                raise BlockImportError("unknown or unsigned extrinsic")
+            payload = ext.payload(self.genesis)
+            if payload in seen_payloads:
+                raise BlockImportError("duplicate extrinsic payload")
+            seen_payloads.add(payload)
+            pks.append(epk)
+            msgs.append(payload)
+            raw_sigs.append(ext.signature)
+        if raw_sigs:
+            try:
+                agg = bls_agg.aggregate_signatures(
+                    [bytes.fromhex(s) for s in raw_sigs]
+                )
+            except ValueError:
+                raise BlockImportError("undecodable signature")
+            if not bls_agg.verify_aggregate(pks, msgs, agg):
+                raise BlockImportError("bad block/extrinsic signature")
+
+        pre_blob = self._state_blobs.get(self.head_hash)
+        self.rt.run_blocks(1)
+        record = BlockRecord(
+            number=self.rt.state.block_number, author=block.author,
+            imported=True)
+        self._apply_extrinsics(exts, record)
+        blob, shash = checkpoint.snapshot_and_hash(self.rt)
+        if shash != block.state_hash:
+            if pre_blob is not None:
+                checkpoint.restore(self.rt, pre_blob)
+            raise BlockImportError("post-state hash mismatch")
+        # advance intake nonces so local submissions stay in step,
+        # and drop now-included extrinsics from our own pool
+        for ext in exts:
+            cur = self.nonces.get(ext.signer, 0)
+            self.nonces[ext.signer] = max(cur, ext.nonce + 1)
+        self.pool.prune(set(record.extrinsics), self.genesis)
+        return record, blob
+
+    def handle_announce(self, block_json: dict) -> str:
+        """`sync_announce` intake: import, or catch up on a gap."""
+        try:
+            block = Block.from_json(block_json)
+        except (KeyError, TypeError, ValueError) as e:
+            raise BlockImportError(f"malformed block: {e!r}")
+        try:
+            rec = self.import_block(block)
+        except SyncGap:
+            if self.sync is not None:
+                self.sync.catch_up()
+            return "gap"
+        except BlockImportError as e:
+            # an unknown parent means the announcer is on another fork —
+            # let catch-up walk back to the common ancestor and decide
+            # by chain length rather than dropping the peer's chain.
+            # (m_import_rejected was already counted by import_block.)
+            if "unknown parent" in str(e) and self.sync is not None:
+                self.sync.catch_up()
+                return "fork"
+            raise
+        return "imported" if rec is not None else "known"
+
+    def reorg_to(self, ancestor_number: int) -> bool:
+        """Rewind the chain to `ancestor_number` (longest-chain fork
+        resolution): restore its cached post-state blob and drop all
+        bookkeeping above it.  Refuses to cross finality or leave the
+        state-blob window."""
+        with self._lock:
+            head_n = self.rt.state.block_number
+            if ancestor_number < self.finalized_number:
+                return False
+            if ancestor_number >= head_n:
+                return True
+            if ancestor_number == 0:
+                anchor = self.genesis
+            else:
+                blk = self.block_by_number.get(ancestor_number)
+                if blk is None:
+                    return False
+                anchor = blk.hash(self.genesis)
+            blob = self._state_blobs.get(anchor)
+            if blob is None:
+                return False
+            checkpoint.restore(self.rt, blob)
+            retracted = []
+            for n in range(ancestor_number + 1, head_n + 1):
+                blk = self.block_by_number.pop(n, None)
+                if blk is not None:
+                    retracted.append(blk)
+                    bh = blk.hash(self.genesis)
+                    self.block_store.pop(bh, None)
+                    self._state_blobs.pop(bh, None)
+            while self.blocks and self.blocks[-1].number > ancestor_number:
+                self.blocks.pop()
+            self.head_hash = anchor
+            # _voted keeps retracted heights on purpose: re-voting a
+            # replaced hash is equivocation (see _rollback_head)
+            self._requeue_retracted(retracted)
+            self.m_reorgs.inc()
+            return True
+
+    # ------------------------------------------------------ finality
+
+    def _finality_target(self) -> tuple[int, str] | None:
+        """Highest multiple of finality_period at or below head (the
+        canonical vote target every replica agrees on)."""
+        period = self.spec.finality_period
+        if period <= 0:
+            return None
+        head_n = self.rt.state.block_number
+        target = head_n - head_n % period
+        if target <= self.finalized_number or target == 0:
+            return None
+        blk = self.block_by_number.get(target)
+        if blk is None:
+            return None
+        return target, blk.hash(self.genesis)
+
+    def _finality_tick(self) -> Vote | None:
+        """Sign + gossip this validator's vote for the current target
+        (the GRANDPA voter role).  Runs from the slot loop and after
+        imports; no-ops for non-validator or keyless nodes.  Returns
+        the vote it cast (tests relay these between lockstep nodes)."""
+        ident = self._ocw_identity
+        if (ident is None or self.authority_sk is None
+                or ident not in self.spec.validators):
+            return None
+        if self.authority is None and self.sync is not None:
+            # networked but keyless: the dev fallback identity would
+            # sign votes under validators[0]'s derived key — a forged
+            # vote that conflicts with the real validator's evicts it
+            # from every tally as an equivocator (same guard as
+            # produce_block)
+            return None
+        with self._lock:
+            tgt = self._finality_target()
+            if tgt is None or tgt[0] in self._voted:
+                return None
+            number, block_hash = tgt
+            self._voted.add(number)
+            sig = bls.sign(
+                self.authority_sk,
+                finality_payload(self.genesis, number, block_hash),
+            ).hex()
+            vote = Vote(number=number, block_hash=block_hash,
+                        voter=ident, signature=sig)
+        # our own signature from two lines up: skip the re-verify pairing
+        self.add_vote(vote, _trusted=True)
+        if self.sync is not None:
+            self.sync.broadcast_vote(vote)
+        return vote
+
+    def add_vote(self, vote: Vote, _trusted: bool = False) -> bool:
+        """Collect one finality vote (own or gossiped).  On a 2/3 quorum
+        the votes aggregate into a justification (ops/bls_agg) that is
+        applied locally and gossiped.  `_trusted=True` skips the ~0.38s
+        pairing for a vote this node signed itself moments ago."""
+        validators = self.spec.validators
+        pk = self.keys.get(vote.voter)
+        if vote.voter not in validators or pk is None:
+            return False
+        # stale/duplicate votes drop BEFORE the ~0.4s pairing: gossip
+        # re-delivers every vote N-1 times, and the RPC intake is
+        # unauthenticated, so replaying one valid vote must stay cheap
+        with self._lock:
+            if vote.number <= self.finalized_number:
+                return False
+            if vote.voter in self._equivocators.get(vote.number, ()):
+                return False
+            seen = self._votes.get((vote.number, vote.block_hash))
+            if seen is not None and vote.voter in seen:
+                return True
+        if not _trusted and not bls.verify(
+            pk, finality_payload(self.genesis, vote.number, vote.block_hash),
+            bytes.fromhex(vote.signature),
+        ):
+            return False
+        just = None
+        with self._lock:
+            if vote.number <= self.finalized_number:
+                return False
+            if vote.voter in self._equivocators.get(vote.number, ()):
+                return False
+            prior = self._vote_hash.get(vote.number, {}).get(vote.voter)
+            if prior is not None and prior != vote.block_hash:
+                # Proven equivocation — both signatures verified (the
+                # prior one at tally time, this one just above; an
+                # unverified conflicting vote must never evict an
+                # honest validator's weight).  Purge the voter from
+                # every tally at this height and refuse further votes.
+                self._equivocators.setdefault(
+                    vote.number, set()).add(vote.voter)
+                for (n, _h), tally in self._votes.items():
+                    if n == vote.number:
+                        tally.pop(vote.voter, None)
+                self._vote_hash[vote.number].pop(vote.voter, None)
+                return False
+            tally = self._votes.setdefault(
+                (vote.number, vote.block_hash), {})
+            if vote.voter in tally:
+                return True
+            tally[vote.voter] = vote.signature
+            self._vote_hash.setdefault(
+                vote.number, {})[vote.voter] = vote.block_hash
+            self.m_votes.inc()
+            if quorum(len(tally), len(validators)):
+                just = Justification.from_votes(
+                    vote.number, vote.block_hash, tally)
+        if just is not None and self.handle_justification(
+            just, _verified=True  # aggregated from individually
+        ):                        # verified votes one line up
+            if self.sync is not None:
+                self.sync.broadcast_justification(just)
+        return True
+
+    def handle_justification(
+        self, just: Justification, _verified: bool = False
+    ) -> bool:
+        """Verify and apply a finality justification; returns True when
+        it advanced our finalized head.  Forged aggregates, sub-quorum
+        signer sets, and non-validator signers are rejected
+        (sync.verify_justification).  `_verified=True` skips the
+        aggregate pairing for a justification this node already
+        verified (buffered pending) or built from verified votes.
+        Stale ones drop before the pairing — every finality period each
+        validator gossips the same justification, and the RPC intake is
+        unauthenticated, so replays must stay cheap."""
+        with self._lock:
+            if just.number <= self.finalized_number:
+                return False
+        if not _verified and not verify_justification(
+            just, self.genesis, self.spec.validators, self.keys
+        ):
+            return False
+        with self._lock:
+            if just.number <= self.finalized_number:
+                return False
+            blk = self.block_by_number.get(just.number)
+            if blk is None or blk.hash(self.genesis) != just.block_hash:
+                # Keep the (already verified) justification and retry
+                # once the justified block imports.  Two ways to get
+                # here: the justification outran its block (dropping it
+                # can stall finality at exactly 2/3 quorum, where no
+                # further votes will ever arrive), or we hold a
+                # COMPETING block at that height (same-height fork) —
+                # the longest-chain rule reorgs us onto the justified
+                # branch within a block, and _post_block replays this.
+                if (blk is not None
+                        or just.number > self.rt.state.block_number):
+                    self._pending_justs[just.number] = just
+                return False
+            self.finalized_number = just.number
+            self.finalized_hash = just.block_hash
+            self.justifications[just.number] = just
+            self.m_finalized.set(just.number)
+            self._votes = {
+                k: v for k, v in self._votes.items()
+                if k[0] > just.number
+            }
+            self._voted = {n for n in self._voted if n > just.number}
+            self._vote_hash = {
+                n: v for n, v in self._vote_hash.items()
+                if n > just.number
+            }
+            self._equivocators = {
+                n: v for n, v in self._equivocators.items()
+                if n > just.number
+            }
+            self._pending_justs = {
+                n: j for n, j in self._pending_justs.items()
+                if n > just.number
+            }
+        return True
+
+    # ------------------------------------------------------ offchain
+
+    def _post_block(self, now: int) -> None:
+        """Per-block offchain hooks: retry a justification that arrived
+        before its block, then the audit OCW pass (reference:
+        lib.rs:342-359) for this node's authority, submitting any
+        challenge vote through its own pool as a signed extrinsic."""
+        with self._lock:
+            pending = self._pending_justs.pop(now, None)
+        if pending is not None and self.handle_justification(
+            pending, _verified=True  # verified when buffered
+        ):
+            if self.sync is not None:
+                self.sync.broadcast_justification(pending)
+        ident = self._ocw_identity
+        if ident is None or self.authority_sk is None:
+            return
+        if self.authority is None and self.sync is not None:
+            # networked but keyless: don't run the audit OCW under the
+            # dev-derived validators[0] identity (same guard as
+            # produce_block / _finality_tick)
+            return
+        with self._lock:
+            if ident not in self.rt.audit.keys:
+                return
+
+            def submit(info):
+                ext = Extrinsic(
+                    signer=ident, module="audit",
+                    call="save_challenge_info",
+                    args=[challenge_info_to_json(info)],
+                    nonce=self.nonces.get(ident, 0),
+                )
+                ext.sign(self.authority_sk, self.genesis)
+                try:
+                    # we signed this ourselves a line ago — skip the
+                    # ~0.38s pairing re-verify while holding the lock
+                    self.submit_extrinsic(ext, _verified=True)
+                except ValueError:
+                    pass
+
+            self.rt.audit.offchain_worker(now, ident, submit=submit)
 
     # ------------------------------------------------------ slot loop
 
@@ -371,9 +1153,37 @@ class NodeService:
 
         def loop():
             period = self.spec.block_time_ms / 1000.0
+            networked = self.sync is not None
+            if networked:
+                # a (re)joining node levels with its peers before taking
+                # its own slots (the initial-sync role); a misbehaving
+                # peer must not kill the authoring thread before it
+                # produces a single block
+                try:
+                    self.sync.catch_up()
+                except Exception:
+                    pass
             while not self._stop.is_set():
                 t0 = time.monotonic()
-                self.produce_block()
+                if networked and self.authority is None:
+                    # keyless observer/RPC full node: gossip only pushes
+                    # to a validator's configured peers, so nothing
+                    # announces to us — follow the network by polling
+                    # catch-up (cheap when level: one sync_status per
+                    # peer) instead of authoring
+                    try:
+                        self.sync.catch_up()
+                    except Exception:
+                        pass
+                elif networked:
+                    # wall-clock slots: every replica derives the same
+                    # slot index from real time, so exactly one
+                    # validator owns each slot (the BABE slot-clock
+                    # discipline) instead of per-node drifting counters
+                    self.produce_block(slot=int(time.time() / period))
+                else:
+                    self.produce_block()
+                self._finality_tick()
                 dt = time.monotonic() - t0
                 self._stop.wait(max(0.0, period - dt))
 
@@ -393,9 +1203,92 @@ class NodeService:
         with self._lock:
             return checkpoint.snapshot(self.rt)
 
+    def _reset_chain_index(self, anchor_hash: str, head: Block | None) -> None:
+        """Re-anchor block bookkeeping after a state restore: history
+        before the restored state is not held, so the anchor (a synthetic
+        hash, or the peer-supplied head block) becomes the parent of the
+        next block."""
+        self.block_store.clear()
+        self.block_by_number.clear()
+        self.blocks.clear()
+        self._state_blobs.clear()
+        self.head_hash = anchor_hash
+        if head is not None:
+            self.block_store[anchor_hash] = head
+            self.block_by_number[head.number] = head
+            self.slot = max(self.slot, head.slot)
+        self._state_blobs[anchor_hash] = checkpoint.snapshot(self.rt)
+        # Re-level the pool-intake high-water marks with the restored
+        # consensus nonces: a rejoined node serving author_nonce from a
+        # stale map would have clients sign already-spent nonces (every
+        # such extrinsic applies as a failed receipt chain-wide).
+        for acct, n in self.rt.state.nonces.items():
+            if self.nonces.get(acct, 0) < n:
+                self.nonces[acct] = n
+
     def import_state(self, blob: bytes) -> None:
+        """Dev/CLI restore: state only, synthetic head anchor (multi-node
+        bootstrap goes through restore_checkpoint, which anchors to the
+        peer's signed head block)."""
         with self._lock:
             checkpoint.restore(self.rt, blob)
+            self._reset_chain_index(
+                "ckpt:" + checkpoint.state_hash(self.rt), None)
+
+    def restore_checkpoint(
+        self, blob: bytes, head: Block | None,
+        justification: Justification | None = None,
+    ) -> bool:
+        """Warp-sync bootstrap (service.rs:259-263 role): restore a
+        peer's versioned state blob, verified against the signed +
+        FINALIZED head block it claims to be the post-state of.  Trust
+        anchors: the head must be signed by a validator, covered by a
+        2/3 BLS-aggregate justification (one compromised validator must
+        not be able to bootstrap a rejoining node onto a fabricated
+        chain), and its state_hash must equal the restored state's
+        hash; a peer lying about any of these is rejected and our state
+        is rolled back."""
+        if head is None or not head.signature:
+            return False
+        try:
+            self._check_author_signature(head)
+        except BlockImportError:
+            return False
+        bh = head.hash(self.genesis)
+        if justification is None:
+            return False
+        if (justification.number != head.number
+                or justification.block_hash != bh):
+            return False
+        if not verify_justification(
+            justification, self.genesis, self.spec.validators, self.keys
+        ):
+            return False
+        with self._lock:
+            if head.number <= self.rt.state.block_number:
+                return False
+            undo = checkpoint.snapshot(self.rt)
+            try:
+                # the blob is peer-supplied: ANY failure mode (bad
+                # format, unknown pallet names, wrong field types) must
+                # land in the undo restore, or a malicious peer leaves
+                # the runtime half-mutated
+                checkpoint.restore(self.rt, blob)
+                ok = (self.rt.state.block_number == head.number
+                      and checkpoint.state_hash(self.rt)
+                      == head.state_hash)
+            except Exception:
+                ok = False
+            if not ok:
+                checkpoint.restore(self.rt, undo)
+                return False
+            self._reset_chain_index(bh, head)
+            # the anchor arrived finalized — start from there
+            self.finalized_number = head.number
+            self.finalized_hash = bh
+            self.justifications[head.number] = justification
+            self.m_finalized.set(head.number)
+        return True
 
     def state_hash(self) -> str:
         with self._lock:

@@ -1,0 +1,535 @@
+"""Block sync + BLS-aggregate finality: the replicated-network layer.
+
+Role match: the reference node's consensus networking (reference:
+node/src/service.rs:219-584 — the import queue, block announce/request
+protocols over libp2p, and the GRANDPA finality gadget with its 2/3
+justifications) re-expressed over this framework's newline-JSON-RPC
+wire (node/rpc.py):
+
+ * **Blocks** (`Block`) carry (parent hash, slot, extrinsic root,
+   post-state hash) signed by the slot author's BLS key.  Authored
+   blocks are announced to every peer (`sync_announce`); importing
+   nodes re-execute the extrinsics deterministically and reject
+   wrong-author, bad-signature, or state-hash-mismatched blocks — the
+   import-queue role, with the runtime's replay determinism
+   (chain/checkpoint.py) as the verification anchor.
+
+ * **Catch-up** (`SyncManager.catch_up`) pulls `sync_status` from
+   peers; small gaps replay the missing block range (`sync_block`),
+   large gaps bootstrap from a versioned checkpoint blob
+   (`sync_checkpoint`, chain/checkpoint.py format) and replay from
+   there — the warp-sync role (service.rs:259-263).
+
+ * **Finality** (`Vote` / `Justification`) is a GRANDPA stand-in:
+   every `finality_period` blocks validators sign the canonical block
+   at the period boundary; 2/3 of the authority set's signatures,
+   BLS-aggregated (ops/bls_agg.py), form a justification that is
+   gossiped, verified at import, and exposed over RPC
+   (`chain_finalized_head`).  Finalized blocks are never reorged.
+
+The wire messages are plain JSON dicts — every constructor verifies
+before trusting, so a malicious peer can at worst be ignored.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+from dataclasses import dataclass, field
+
+from ..ops import bls12_381 as bls
+from ..ops import bls_agg
+
+
+def _rpc(host: str, port: int, method: str, params: list,
+         timeout: float):
+    """Lazy one-shot RPC (node/rpc.py imports service which imports this
+    module — the deferred import breaks the cycle)."""
+    from .rpc import rpc_call
+
+    return rpc_call(host, port, method, params, timeout=timeout)
+
+
+def _rpc_errors() -> tuple[type, ...]:
+    from .rpc import RpcError
+
+    return (OSError, RpcError, ValueError, KeyError)
+
+# Bumped when the sync wire format changes; peers with a different
+# version are skipped during catch-up.
+SYNC_PROTO_VERSION = 1
+
+# Peer-gossip socket timeout: announcements are fire-and-forget, a dead
+# peer must not stall the authoring loop.
+GOSSIP_TIMEOUT_S = 3.0
+
+# Max gossip messages queued per peer.  A hung peer drains at ~1 message
+# per timeout while blocks enqueue several per slot — without a cap the
+# queue (full block JSON each) grows without bound.  Dropping is safe:
+# gossip is best-effort and catch-up recovers anything missed.
+GOSSIP_QUEUE_MAX = 64
+
+
+# ------------------------------------------------------------ block wire
+
+
+def canonical_json(obj) -> bytes:
+    """THE canonical byte encoding every consensus payload is signed
+    and hashed over (blocks, extrinsics, finality votes).  Single
+    definition on purpose: replicas that disagree on one byte here
+    reject each other's signatures and state hashes."""
+    return json.dumps(obj, sort_keys=True, separators=(",", ":")).encode()
+
+
+def extrinsic_root(extrinsics: list[dict]) -> str:
+    """Commitment to the block body (the extrinsics-root role of the
+    reference header): blake2b over the canonical JSON of the body."""
+    return hashlib.blake2b(
+        canonical_json(extrinsics), digest_size=32,
+    ).hexdigest()
+
+
+@dataclass
+class Block:
+    """One announced block: header fields + full body.  `state_hash` is
+    the POST-state hash (chain/checkpoint.py state_hash) — the import
+    check that pins replay determinism across replicas."""
+
+    number: int
+    slot: int
+    parent: str          # parent block hash (hex; genesis hash for #1)
+    author: str          # validator account that owned the slot
+    state_hash: str      # post-execution state hash
+    extrinsics: list[dict] = field(default_factory=list)
+    signature: str = ""  # author's BLS signature over signing_payload()
+
+    def signing_payload(self, genesis: str) -> bytes:
+        return canonical_json(
+            [
+                genesis, "block", self.number, self.slot, self.parent,
+                self.author, extrinsic_root(self.extrinsics),
+                self.state_hash,
+            ]
+        )
+
+    def sign(self, sk: int, genesis: str) -> "Block":
+        self.signature = bls.sign(sk, self.signing_payload(genesis)).hex()
+        return self
+
+    def hash(self, genesis: str) -> str:
+        return hashlib.blake2b(
+            self.signing_payload(genesis) + bytes.fromhex(self.signature),
+            digest_size=32,
+        ).hexdigest()
+
+    def to_json(self) -> dict:
+        return {
+            "number": self.number, "slot": self.slot,
+            "parent": self.parent, "author": self.author,
+            "stateHash": self.state_hash, "extrinsics": self.extrinsics,
+            "sig": self.signature,
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "Block":
+        return cls(
+            number=int(d["number"]), slot=int(d["slot"]),
+            parent=str(d["parent"]), author=str(d["author"]),
+            state_hash=str(d["stateHash"]),
+            extrinsics=list(d.get("extrinsics", [])),
+            signature=str(d.get("sig", "")),
+        )
+
+
+class BlockImportError(ValueError):
+    """Block failed verification (author, signature, parent, state)."""
+
+
+class SyncGap(Exception):
+    """Announced block is ahead of our head — catch-up required."""
+
+    def __init__(self, have: int, want: int):
+        super().__init__(f"gap: have {have}, announced {want}")
+        self.have = have
+        self.want = want
+
+
+# ------------------------------------------------------------ finality
+
+
+def finality_payload(genesis: str, number: int, block_hash: str) -> bytes:
+    """Canonical bytes every validator signs to finalize a block —
+    identical for all signers, so signatures aggregate (bls_agg)."""
+    return canonical_json([genesis, "finality", number, block_hash])
+
+
+@dataclass
+class Vote:
+    """One validator's finality vote for (number, hash)."""
+
+    number: int
+    block_hash: str
+    voter: str
+    signature: str  # hex BLS signature over finality_payload()
+
+    def to_json(self) -> dict:
+        return {
+            "number": self.number, "hash": self.block_hash,
+            "voter": self.voter, "sig": self.signature,
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "Vote":
+        return cls(
+            number=int(d["number"]), block_hash=str(d["hash"]),
+            voter=str(d["voter"]), signature=str(d["sig"]),
+        )
+
+
+@dataclass
+class Justification:
+    """2/3-aggregate finality proof: the GRANDPA justification role.
+    `signers` lists the contributing validators (sorted); `agg_sig` is
+    the BLS aggregate of their votes (ops/bls_agg.aggregate_signatures)
+    over the shared finality payload."""
+
+    number: int
+    block_hash: str
+    signers: list[str]
+    agg_sig: str  # hex, 48-byte compressed G1 aggregate
+
+    def to_json(self) -> dict:
+        return {
+            "number": self.number, "hash": self.block_hash,
+            "signers": list(self.signers), "agg": self.agg_sig,
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "Justification":
+        return cls(
+            number=int(d["number"]), block_hash=str(d["hash"]),
+            signers=[str(s) for s in d["signers"]],
+            agg_sig=str(d["agg"]),
+        )
+
+    @classmethod
+    def from_votes(
+        cls, number: int, block_hash: str, votes: dict[str, str]
+    ) -> "Justification":
+        signers = sorted(votes)
+        agg = bls_agg.aggregate_signatures(
+            [bytes.fromhex(votes[s]) for s in signers]
+        )
+        return cls(
+            number=number, block_hash=block_hash,
+            signers=signers, agg_sig=agg.hex(),
+        )
+
+
+def quorum(n_signers: int, n_validators: int) -> bool:
+    """GRANDPA-style 2/3 supermajority over the authority set."""
+    return n_validators > 0 and 3 * n_signers >= 2 * n_validators
+
+
+def verify_justification(
+    just: Justification,
+    genesis: str,
+    validators: list[str],
+    keys: dict[str, bytes],
+) -> bool:
+    """Full check: signer set ⊆ validators, distinct, 2/3 quorum, and
+    the BLS aggregate verifies over the canonical finality payload.
+    Forged aggregates, non-validator signers, and sub-quorum sets are
+    all rejected — asserted in tests/test_zz_sync.py."""
+    signers = just.signers
+    if len(set(signers)) != len(signers):
+        return False
+    if not set(signers) <= set(validators):
+        return False
+    if not quorum(len(signers), len(validators)):
+        return False
+    pks = []
+    for s in signers:
+        pk = keys.get(s)
+        if pk is None:
+            return False
+        pks.append(pk)
+    payload = finality_payload(genesis, just.number, just.block_hash)
+    try:
+        agg = bytes.fromhex(just.agg_sig)
+    except ValueError:
+        return False
+    return bls_agg.verify_aggregate(pks, [payload] * len(pks), agg)
+
+
+# ------------------------------------------------------------ sync manager
+
+
+class SyncManager:
+    """One node's view of its peers: gossip fan-out + catch-up.
+
+    Transport is the one-shot newline-JSON RPC client (rpc.rpc_call) —
+    each gossip message is its own short-lived connection, so a dead
+    peer costs one timeout and nothing else.  `checkpoint_gap` is the
+    warp-sync threshold: a node more than this many blocks behind
+    bootstraps from a peer's versioned checkpoint blob instead of
+    replaying every block."""
+
+    def __init__(
+        self,
+        service,
+        peers: list[tuple[str, int]],
+        checkpoint_gap: int = 64,
+    ) -> None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        self.service = service
+        self.peers = list(peers)
+        self.checkpoint_gap = checkpoint_gap
+        self._catchup_lock = threading.Lock()
+        # One single-worker pool PER PEER: gossip to a given peer is
+        # delivered in submission order (a same-signer extrinsic burst
+        # must not arrive nonce-reversed at a strict-nonce intake), it
+        # never blocks the authoring loop, and a slow peer only backs up
+        # its own queue.
+        self._pools = {
+            peer: ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix=f"gossip-{peer[1]}",
+            )
+            for peer in self.peers
+        }
+        self._queue_lock = threading.Lock()
+        self._queued = {peer: 0 for peer in self.peers}
+        service.attach_sync(self)
+
+    def stop(self) -> None:
+        """Drop queued gossip and release the worker threads."""
+        for pool in self._pools.values():
+            pool.shutdown(wait=False, cancel_futures=True)
+
+    # ------------------------------------------------------ gossip out
+
+    def _cast(self, method: str, params: list) -> None:
+        """Fire-and-forget to every peer via its ordered gossip queue:
+        the authoring loop must never block on a peer's import time
+        (the receiving handler verifies + re-executes synchronously)."""
+
+        def one(peer):
+            try:
+                _rpc(*peer, method, params, GOSSIP_TIMEOUT_S)
+            except _rpc_errors():
+                pass
+            finally:
+                with self._queue_lock:
+                    self._queued[peer] -= 1
+
+        for peer in self.peers:
+            with self._queue_lock:
+                if self._queued[peer] >= GOSSIP_QUEUE_MAX:
+                    continue  # hung peer: drop rather than queue forever
+                self._queued[peer] += 1
+            try:
+                self._pools[peer].submit(one, peer)
+            except RuntimeError:  # pool shut down during service stop
+                with self._queue_lock:
+                    self._queued[peer] -= 1
+
+    def announce_block(self, block: Block) -> None:
+        self._cast("sync_announce", [block.to_json()])
+
+    def broadcast_extrinsic(self, ext) -> None:
+        """Tx gossip (the reference pool's propagation role): peers get
+        the extrinsic in their own pools, so the next slot author —
+        whoever it is — includes it."""
+        self._cast("author_gossipExtrinsic", [ext.to_json()])
+
+    def broadcast_vote(self, vote: Vote) -> None:
+        self._cast("sync_vote", [vote.to_json()])
+
+    def broadcast_justification(self, just: Justification) -> None:
+        self._cast("sync_justification", [just.to_json()])
+
+    # ------------------------------------------------------ catch-up
+
+    def _peer_status(self, host: str, port: int) -> dict | None:
+        try:
+            st = _rpc(host, port, "sync_status", [], GOSSIP_TIMEOUT_S)
+        except _rpc_errors():
+            return None
+        # peer-controlled JSON: pin the shape before anyone indexes it
+        if not isinstance(st, dict):
+            return None
+        if st.get("version") != SYNC_PROTO_VERSION:
+            return None
+        if st.get("genesis") != self.service.genesis:
+            return None
+        if not isinstance(st.get("number"), int):
+            return None
+        return st
+
+    def best_peer(self) -> tuple[tuple[str, int], dict] | None:
+        """The alive same-chain peer with the highest head."""
+        best = None
+        for peer in self.peers:
+            st = self._peer_status(*peer)
+            if st is None:
+                continue
+            if best is None or st["number"] > best[1]["number"]:
+                best = (peer, st)
+        return best
+
+    def catch_up(self) -> int:
+        """Close the gap to the best peer: checkpoint bootstrap when far
+        behind, then block-by-block replay to head.  Returns the number
+        of blocks imported.  Reentrant calls coalesce (one catch-up at
+        a time; concurrent announce-triggered calls return 0)."""
+        if not self._catchup_lock.acquire(blocking=False):
+            return 0
+        try:
+            return self._catch_up_locked()
+        finally:
+            self._catchup_lock.release()
+
+    def _catch_up_locked(self) -> int:
+        s = self.service
+        best = self.best_peer()
+        if best is None:
+            return 0
+        (host, port), st = best
+        imported = 0
+        # Block replay to the peer's head (the peer may advance while we
+        # replay; chase until level or the peer stops answering).  A
+        # peer on another fork with a LONGER chain wins (longest-chain
+        # rule): rewind to the common ancestor and replay theirs.
+        # Replay verifies one aggregate pairing per block — barely
+        # faster than production — so whenever the peer's FINALIZED head
+        # moves past ours and the gap exceeds checkpoint_gap, warp-sync
+        # again instead of crawling block by block.
+        rewinds = 0
+        allow_warp = True
+        while True:
+            target = self._peer_status(host, port)
+            if target is None:
+                break
+            if s.head_number() >= target["number"]:
+                # Level with the peer's head.  Justifications are pushed
+                # to the VALIDATORS' configured peers only, so a node the
+                # validators don't know about (keyless observer) must
+                # pull finality for blocks it already holds.
+                self._pull_finality(host, port, target)
+                break
+            fin = target.get("finalized")
+            peer_fin = fin.get("number") if isinstance(fin, dict) else 0
+            if (
+                allow_warp
+                and target["number"] - s.head_number() > self.checkpoint_gap
+                and isinstance(peer_fin, int)
+                and peer_fin > s.head_number()
+            ):
+                before = s.head_number()
+                if (self._bootstrap_checkpoint(host, port)
+                        and s.head_number() > before):
+                    s.m_catchup.inc()
+                    continue
+                allow_warp = False  # unjustified/evicted anchor: replay
+            n = s.head_number() + 1
+            try:
+                d = _rpc(host, port, "sync_block", [n], GOSSIP_TIMEOUT_S)
+            except _rpc_errors():
+                break
+            try:
+                rec = s.import_block(Block.from_json(d["block"]))
+            except BlockImportError as e:
+                if "unknown parent" in str(e) and rewinds < 2:
+                    rewinds += 1
+                    if self._rewind_to_common(host, port):
+                        continue
+                break
+            except (SyncGap, KeyError, ValueError, TypeError,
+                    AttributeError):
+                break  # half-compliant peer response; give up on it
+            if d.get("justification"):
+                try:
+                    s.handle_justification(
+                        Justification.from_json(d["justification"])
+                    )
+                except (KeyError, TypeError, ValueError):
+                    pass  # malformed justification: keep the block
+            if rec is not None:  # None: a concurrent gossip import won
+                imported += 1
+        return imported
+
+    def _pull_finality(self, host: str, port: int, status: dict) -> None:
+        """Fetch the justification for the peer's finalized head when it
+        is ahead of ours and we already hold the block.  Verification
+        (2/3 aggregate over known validators) happens inside
+        ``handle_justification`` — a lying peer gains nothing."""
+        s = self.service
+        fin = status.get("finalized")
+        peer_fin = fin.get("number") if isinstance(fin, dict) else 0
+        if (
+            not isinstance(peer_fin, int)
+            or peer_fin <= s.finalized_number
+            or peer_fin > s.head_number()
+        ):
+            return
+        try:
+            d = _rpc(host, port, "sync_block", [peer_fin], GOSSIP_TIMEOUT_S)
+        except _rpc_errors():
+            return
+        j = d.get("justification") if isinstance(d, dict) else None
+        if j:
+            try:
+                s.handle_justification(Justification.from_json(j))
+            except (KeyError, TypeError, ValueError):
+                pass  # malformed: next poll tries another peer
+
+    def _rewind_to_common(self, host: str, port: int) -> bool:
+        """Fork resolution: walk back from our head until the peer's
+        block at that height matches ours, then reorg there (bounded by
+        finality and the service's state-blob window)."""
+        s = self.service
+        head_n = s.head_number()
+        # the rewind window must stay inside the service's post-state
+        # blob cache, else reorg_to finds no blob to restore
+        window = getattr(s, "STATE_CACHE_BLOCKS", 64) - 8
+        floor = max(s.finalized_number, head_n - window)
+        for n in range(head_n, floor - 1, -1):
+            if n == 0:
+                return s.reorg_to(0)
+            ours = s.block_by_number.get(n)
+            if ours is None:
+                continue
+            try:
+                d = _rpc(host, port, "sync_block", [n], GOSSIP_TIMEOUT_S)
+            except _rpc_errors():
+                return False
+            try:
+                # .hash() decodes the sig hex — a garbage "sig" raises
+                # here too, and must read as "no match", not an abort
+                theirs = Block.from_json(d["block"])
+                matched = theirs.hash(s.genesis) == ours.hash(s.genesis)
+            except (KeyError, TypeError, ValueError):
+                return False
+            if matched:
+                return s.reorg_to(n)
+        return False
+
+    def _bootstrap_checkpoint(self, host: str, port: int) -> bool:
+        """Warp-sync: restore the peer's versioned state blob and anchor
+        the head so subsequent imports chain onto it."""
+        try:
+            d = _rpc(host, port, "sync_checkpoint", [], 30.0)
+        except _rpc_errors():
+            return False
+        try:
+            blob = bytes.fromhex(d["blob"])
+            head = Block.from_json(d["head"]) if d.get("head") else None
+            just = (
+                Justification.from_json(d["justification"])
+                if d.get("justification") else None
+            )
+        except (KeyError, ValueError, TypeError, AttributeError):
+            return False
+        return self.service.restore_checkpoint(blob, head, just)

@@ -32,6 +32,8 @@ tests/test_bls12_381.py::TestReferenceKATs.
 from __future__ import annotations
 
 import hashlib
+import threading
+from collections import OrderedDict
 
 # ---------------------------------------------------------------- parameters
 
@@ -283,7 +285,15 @@ class Fq12:
     __rmul__ = __mul__
 
     def square(self) -> "Fq12":
-        return self * self
+        # complex squaring: 2 Fq6 muls instead of the generic mul's 3 —
+        # the final exponentiation is square-dominated, so this is the
+        # single highest-leverage pairing op
+        t0 = self.c0 * self.c1
+        return Fq12(
+            (self.c0 + self.c1) * (self.c0 + self.c1.mul_by_v())
+            - t0 - t0.mul_by_v(),
+            t0 + t0,
+        )
 
     def conjugate(self) -> "Fq12":
         """The p^6-Frobenius: c0 - c1·w."""
@@ -295,14 +305,24 @@ class Fq12:
         return Fq12(self.c0 * ninv, -(self.c1 * ninv))
 
     def pow(self, e: int) -> "Fq12":
+        """4-bit fixed-window exponentiation: the ~2000-bit final-exp
+        exponent costs ~n squares + n/4 muls instead of n + n/2."""
         if e < 0:
             return self.inv().pow(-e)
-        result, base = FQ12_ONE, self
+        if e == 0:
+            return FQ12_ONE
+        table = [FQ12_ONE, self]
+        for _ in range(14):
+            table.append(table[-1] * self)
+        digits = []
         while e:
-            if e & 1:
-                result = result * base
-            base = base.square()
-            e >>= 1
+            digits.append(e & 15)
+            e >>= 4
+        result = table[digits[-1]]
+        for d in reversed(digits[:-1]):
+            result = result.square().square().square().square()
+            if d:
+                result = result * table[d]
         return result
 
     def is_one(self) -> bool:
@@ -683,46 +703,92 @@ def _untwist(q: G2Point) -> tuple[Fq12, Fq12]:
     return (Fq12.from_fq2(q.x) * w2_inv, Fq12.from_fq2(q.y) * w3_inv)
 
 
-def _line(
-    t: tuple[Fq12, Fq12], q: tuple[Fq12, Fq12], p: tuple[Fq12, Fq12]
-) -> tuple[Fq12, tuple[Fq12, Fq12]]:
-    """Evaluate the line through t,q at p; return (value, t+q).
-
-    Affine chord-and-tangent in Fp12 — the classic formulation (clarity
-    over speed; the TPU path has its own formulas).
-    """
+def _line_coeff(t, q):
+    """One chord-and-tangent step of the affine Miller loop, Q-side
+    only: the slope and chord point involve no G1 input, so they are
+    precomputable per Q.  Returns ((mode, lam, tx, ty), t+q) where
+    mode 0 = sloped line (evaluate -((px-tx)·lam - (py-ty))) and
+    mode 1 = vertical (evaluate px - tx)."""
     tx, ty = t
     qx, qy = q
-    px, py = p
     if tx == qx and ty == qy:
         lam = tx.square() * 3 * (ty * 2).inv()
     elif tx == qx:
-        # vertical line
-        return px - tx, (None, None)
+        return (1, None, tx, ty), (None, None)
     else:
         lam = (qy - ty) * (qx - tx).inv()
-    value = (px - tx) * lam - (py - ty)
     x3 = lam.square() - tx - qx
     y3 = lam * (tx - x3) - ty
-    return -value, (x3, y3)
+    return (0, lam, tx, ty), (x3, y3)
+
+
+def _q_coeffs(q: G2Point) -> list:
+    """Per-Q Miller-loop line coefficients.  Every slope/inversion in
+    the loop depends only on Q, so for recurring Q's (the G2 generator
+    in every signature check, each validator's registered key) the
+    whole inversion chain is computed once and the per-pairing work is
+    evaluation only."""
+    qt = _untwist(q)
+    coeffs = []
+    t = qt
+    for bit in bin(BLS_X)[3:]:
+        c, t = _line_coeff(t, t)
+        coeffs.append(c)
+        if bit == "1":
+            c, t = _line_coeff(t, qt)
+            coeffs.append(c)
+    return coeffs
+
+
+# LRU keyed by the affine G2 coordinates.  Verifies run concurrently
+# from RPC/gossip/import threads, so all cache access is under a lock;
+# recency eviction keeps hot keys (validators, the G2 generator) cached
+# even when the account population exceeds the capacity.
+_Q_COEFF_CACHE: "OrderedDict" = OrderedDict()
+_Q_COEFF_CACHE_MAX = 256
+_Q_COEFF_LOCK = threading.Lock()
+
+
+def _q_coeffs_cached(q: G2Point) -> list:
+    key = (q.x.c0, q.x.c1, q.y.c0, q.y.c1)
+    with _Q_COEFF_LOCK:
+        hit = _Q_COEFF_CACHE.get(key)
+        if hit is not None:
+            _Q_COEFF_CACHE.move_to_end(key)
+            return hit
+    coeffs = _q_coeffs(q)  # expensive inversion chain: outside the lock
+    with _Q_COEFF_LOCK:
+        _Q_COEFF_CACHE[key] = coeffs
+        _Q_COEFF_CACHE.move_to_end(key)
+        while len(_Q_COEFF_CACHE) > _Q_COEFF_CACHE_MAX:
+            _Q_COEFF_CACHE.popitem(last=False)
+    return coeffs
 
 
 def miller_loop(p: G1Point, q: G2Point) -> Fq12:
     """Miller loop of the optimal ate pairing (negative-x BLS12:
     conjugate at the end) — reference capability:
-    utils/verify-bls-signatures/src/lib.rs:85-100."""
+    utils/verify-bls-signatures/src/lib.rs:85-100.  Q-side line
+    coefficients come from the per-Q cache; the per-call work is the
+    G1-side evaluation and the f accumulation."""
     if p.is_infinity() or q.is_infinity():
         return FQ12_ONE
-    qt = _untwist(q)
-    pe = (Fq12.from_int(p.x), Fq12.from_int(p.y))
+    coeffs = _q_coeffs_cached(q)
+    px, py = Fq12.from_int(p.x), Fq12.from_int(p.y)
+
+    def line_at_p(c):
+        mode, lam, tx, ty = c
+        # vertical line (mode) vs sloped tangent/chord through T
+        return px - tx if mode else -((px - tx) * lam - (py - ty))
+
     f = FQ12_ONE
-    t = qt
+    i = 0
     for bit in bin(BLS_X)[3:]:
-        line_val, t = _line(t, t, pe)
-        f = f.square() * line_val
+        f = f.square() * line_at_p(coeffs[i])
+        i += 1
         if bit == "1":
-            line_val, t = _line(t, qt, pe)
-            f = f * line_val
+            f = f * line_at_p(coeffs[i])
+            i += 1
     # x < 0 ⇒ conjugate (Frobenius^6)
     return f.conjugate()
 

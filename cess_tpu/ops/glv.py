@@ -28,7 +28,7 @@ scalar reducible mod r).
 Everything runs over the ops/g1.py loose-limb field kernels; the Pallas
 tile kernel keeps the whole chain (clear → φ table → ladder) VMEM-
 resident, and the plain-XLA core is bit-identical for CPU meshes and
-the multi-chip dryrun (tests/test_glv.py asserts group-level equality
+the multi-chip dryrun (tests/test_fused.py::TestGlv asserts group-level equality
 with the host fold).
 """
 
@@ -307,15 +307,22 @@ def _glv_fold_pallas(X, Y, Z, k1, k2, clear: bool):
     )(k1, k2, X, Y, Z, t35, t3, t2, padv, beta_c)
 
 
+# Module-level jit with `clear` static: the fold compiles once per
+# (shape, clear) and is reused — wrapping jax.jit(partial(...)) at each
+# call site builds a fresh jit object per verify and retraces every
+# time (~65 s/call on the round-4 bench).
+_glv_fold_pallas_jit = jax.jit(
+    _glv_fold_pallas, static_argnames=("clear",)
+)
+
+
 def glv_fold(X, Y, Z, k1, k2, clear: bool = True):
     """Per-lane [k1 + k2·λ]([h_eff]P) (clear=True) or [k1 + k2·λ]P on
     subgroup inputs (clear=False).  (33, N) limb arrays in, projective
     accumulator triple out.  Fused Pallas tiles on TPU when the lane
     count divides into tiles; bit-identical per-op XLA elsewhere."""
     if jax.default_backend() == "tpu" and X.shape[1] % _GLV_TILE == 0:
-        return jax.jit(partial(_glv_fold_pallas, clear=clear))(
-            X, Y, Z, k1, k2
-        )
+        return _glv_fold_pallas_jit(X, Y, Z, k1, k2, clear=clear)
     return _glv_fold_xla(X, Y, Z, k1, k2, clear=clear)
 
 

@@ -137,7 +137,22 @@ def _mu_words_to_limbs(words: jnp.ndarray) -> jnp.ndarray:
 
 
 def _tree_reduce_last(points):
-    return g1.tree_reduce(points, points[0].shape[-1])
+    """Σ over the last axis, padded to a power of two with identity
+    points (0 : 1 : 0) first — g1.tree_reduce's pairwise halving
+    silently drops lanes on odd axis lengths, so a 3- or 5-chunk batch
+    (tests/test_zz_fused_multichunk.py) must never reach it unpadded."""
+    X, Y, Z = points
+    n = X.shape[-1]
+    npow = 1 << max(0, (n - 1).bit_length())
+    if npow != n:
+        pad = [(0, 0)] * (X.ndim - 1) + [(0, npow - n)]
+        X = jnp.pad(X, pad)
+        Z = jnp.pad(Z, pad)
+        Y = jnp.concatenate(
+            [Y, glv._limb_one(Y[..., : npow - n]).astype(Y.dtype)],
+            axis=-1,
+        )
+    return g1.tree_reduce((X, Y, Z), npow)
 
 
 @jax.jit

@@ -72,6 +72,14 @@ class XlaBackend(ProofBackend):
         # proof/fused.py on a real TPU); True/False force it — tests
         # force True to exercise the fused path on the CPU mesh.
         # Verdicts are bit-identical either way (tests/test_fused.py).
+        # The fused pipeline is single-device: forcing it alongside a
+        # mesh would silently ignore the sharded data plane the caller
+        # asked for, so the combination is rejected outright.
+        if fused and mesh is not None:
+            raise ValueError(
+                "fused=True is single-device and incompatible with a "
+                "mesh; use fused=None/False on meshed backends"
+            )
         self.fused = fused
         # device_h2c: None = auto (device SSWU only on a real TPU, where
         # the fused Pallas map wins); True/False force it — tests force
@@ -176,8 +184,8 @@ class XlaBackend(ProofBackend):
         use_fused = (
             self.fused
             if self.fused is not None
-            else jax.default_backend() == "tpu" and self.mesh is None
-        )
+            else jax.default_backend() == "tpu"
+        ) and self.mesh is None
         if use_fused:
             from .fused import combined_check_fused
 

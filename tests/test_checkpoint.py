@@ -67,3 +67,68 @@ def test_snapshot_is_pure_data():
     fresh.tee_worker.cert_verifier = marker
     checkpoint.restore(fresh, blob)
     assert fresh.tee_worker.cert_verifier is marker
+
+
+def small_runtime() -> Runtime:
+    """Cheap non-trivial state for format tests (no NodeSim: these run
+    early in the tier-1 alphabet and must stay fast)."""
+    rt = Runtime(RuntimeConfig(
+        podr2_chunk_count=PARAMS.n, genesis_validators=["alice"],
+        endowed={"carol": 10**12},
+    ))
+    rt.run_blocks(3)
+    rt.state.balances.mint("dave", 7)
+    return rt
+
+
+class TestVersionedFormat:
+    """Snapshot blobs travel between nodes (sync catch-up) and across
+    builds, so they carry a version header and a migration registry
+    (the audit/src/migrations.rs:9-41 role)."""
+
+    def test_blob_carries_header_and_roundtrips(self):
+        rt = small_runtime()
+        blob = checkpoint.snapshot(rt)
+        assert blob.startswith(checkpoint.MAGIC)
+        version, _ = checkpoint.decode_blob(blob)
+        assert version == checkpoint.FORMAT_VERSION
+        fresh = Runtime(copy.copy(rt.config))
+        checkpoint.restore(fresh, blob)
+        assert checkpoint.state_hash(fresh) == checkpoint.state_hash(rt)
+
+    def test_v1_fixture_upgrades(self):
+        """A v(N−1) blob — the headerless original format — restores
+        through the migration chain into the current runtime."""
+        rt = small_runtime()
+        v1_blob = checkpoint.state_encode(rt)  # bare payload = v1
+        assert not v1_blob.startswith(checkpoint.MAGIC)
+        version, _ = checkpoint.decode_blob(v1_blob)
+        assert version == 1
+        fresh = Runtime(copy.copy(rt.config))
+        checkpoint.restore(fresh, v1_blob)
+        assert checkpoint.state_hash(fresh) == checkpoint.state_hash(rt)
+
+    def test_future_version_rejected(self):
+        rt = small_runtime()
+        payload = checkpoint.state_encode(rt)
+        future = checkpoint.MAGIC + (
+            checkpoint.FORMAT_VERSION + 1
+        ).to_bytes(2, "big") + payload
+        fresh = Runtime(copy.copy(rt.config))
+        try:
+            checkpoint.restore(fresh, future)
+        except ValueError as e:
+            assert "newer" in str(e)
+        else:
+            raise AssertionError("future-version blob must be rejected")
+
+    def test_state_hash_is_header_independent(self):
+        """state_hash hashes the payload only: the replay-determinism
+        anchor does not change when the envelope format is bumped."""
+        import hashlib
+
+        rt = small_runtime()
+        blob, h = checkpoint.snapshot_and_hash(rt)
+        assert h == checkpoint.state_hash(rt)
+        header_len = len(checkpoint.MAGIC) + 2
+        assert hashlib.sha256(blob[header_len:]).hexdigest() == h

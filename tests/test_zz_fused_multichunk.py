@@ -1,0 +1,97 @@
+"""Multi-chunk fused verification (proof/fused.py): with CHUNK
+monkeypatched small, 3- and 5-chunk batches must agree with CpuBackend
+— all-honest and one-bad-proof.  Guards the non-power-of-two chunk
+accumulation (g1.tree_reduce silently drops lanes on odd axis lengths;
+_tree_reduce_last pads to pow2 with identity points).
+
+Sorts late (zz): the chunk program compiles per (lane, proof-axis)
+shape, so a tier-1 timeout truncates this file, not the broad suite.
+Tiles are monkeypatched to 8 so the padded programs stay tiny on the
+CPU mesh (the XLA path is lane-count agnostic)."""
+
+import pytest
+
+from cess_tpu.ops import glv, h2c, podr2
+from cess_tpu.ops.bls12_381 import R
+from cess_tpu.ops.podr2 import Challenge, Podr2Params, keygen, tag_fragment
+from cess_tpu.proof import CpuBackend, fused
+from cess_tpu.proof.xla_backend import XlaBackend
+
+PARAMS = Podr2Params(n=8, s=4)
+SK, PK = keygen(b"multichunk-tee")
+
+
+def make_challenge(indices, seed=b"mc"):
+    randoms = tuple(
+        (seed + i.to_bytes(2, "little")).ljust(20, b"\x5a") for i in indices
+    )
+    return Challenge(indices=tuple(indices), randoms=randoms)
+
+
+@pytest.fixture(scope="module")
+def proved5():
+    ch = make_challenge([0, 2, 5])
+    items = []
+    for k in range(5):
+        name = f"mc-frag-{k}".encode()
+        data = bytes(
+            [(k * 37 + i) % 256 for i in range(PARAMS.fragment_bytes)]
+        )
+        tags = tag_fragment(SK, name, data, PARAMS)
+        items.append((name, ch, podr2.prove(tags, data, ch, PARAMS)))
+    return items
+
+
+@pytest.fixture(autouse=True)
+def small_chunks(monkeypatch):
+    # CHUNK=1 → every proof is its own chunk: 3 items = 3 chunks,
+    # 5 items = 5 chunks — both odd, exercising the pow2 padding.
+    monkeypatch.setattr(fused, "CHUNK", 1)
+    monkeypatch.setattr(h2c, "_MAP_TILE", 8)
+    monkeypatch.setattr(glv, "_GLV_TILE", 8)
+
+
+class TestMultiChunk:
+    def test_three_chunks_all_honest(self, proved5):
+        items = proved5[:3]
+        assert fused.combined_check_fused(PK, items, b"r3", PARAMS)
+        assert XlaBackend(fused=True).verify_batch(
+            PK, items, b"r3", PARAMS
+        ) == CpuBackend().verify_batch(PK, items, b"r3", PARAMS) == [True] * 3
+
+    def test_five_chunks_all_honest(self, proved5):
+        assert fused.combined_check_fused(PK, proved5, b"r5", PARAMS)
+        assert XlaBackend(fused=True).verify_batch(
+            PK, proved5, b"r5", PARAMS
+        ) == [True] * 5
+
+    def test_five_chunks_one_bad_proof(self, proved5):
+        bad = list(proved5)
+        name, ch, proof = bad[3]
+        t = podr2.Podr2Proof(proof.sigma, list(proof.mu))
+        t.mu[0] = (t.mu[0] + 1) % R
+        bad[3] = (name, ch, t)
+        cpu = CpuBackend().verify_batch(PK, bad, b"rb", PARAMS)
+        fus = XlaBackend(fused=True).verify_batch(PK, bad, b"rb", PARAMS)
+        assert cpu == fus == [True, True, True, False, True]
+
+    def test_three_chunks_one_bad_proof(self, proved5):
+        bad = list(proved5[:3])
+        name, ch, proof = bad[1]
+        t = podr2.Podr2Proof(proof.sigma, list(proof.mu))
+        t.mu[-1] = (t.mu[-1] + 1) % R
+        bad[1] = (name, ch, t)
+        cpu = CpuBackend().verify_batch(PK, bad, b"rc", PARAMS)
+        fus = XlaBackend(fused=True).verify_batch(PK, bad, b"rc", PARAMS)
+        assert cpu == fus == [True, False, True]
+
+
+class TestFusedMeshGuard:
+    def test_fused_with_mesh_rejected(self):
+        """Satellite: forcing fused=True alongside a mesh must fail
+        loudly instead of silently ignoring the mesh."""
+        class FakeMesh:
+            pass
+
+        with pytest.raises(ValueError, match="mesh"):
+            XlaBackend(mesh=FakeMesh(), fused=True)

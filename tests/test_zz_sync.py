@@ -1,0 +1,588 @@
+"""Multi-node sync + finality (cess_tpu/node/sync.py): block
+propagation and deterministic re-execution, forged-author /
+forged-signature / state-mismatch rejection, same-height fork choice,
+2/3 BLS-aggregate justifications, forged-justification rejection, and
+catch-up (block replay + versioned-checkpoint bootstrap) over real RPC
+sockets.
+
+Protocol-level: CpuBackend / host BLS only — no device compiles.  The
+file sorts late (zz) so a tier-1 timeout truncates it, not the broad
+suite (ROADMAP tier-1 budget discipline)."""
+
+import time
+
+import pytest
+
+from cess_tpu.node import (
+    Block,
+    BlockImportError,
+    Justification,
+    NodeService,
+    RpcServer,
+    SyncManager,
+    local_spec,
+)
+from cess_tpu.node.chain_spec import ChainSpec, dev_sk
+from cess_tpu.node.metrics import scoped_registry
+from cess_tpu.node.sync import quorum, verify_justification
+from cess_tpu.ops import bls12_381 as bls
+from cess_tpu.ops import bls_agg
+
+
+def make_spec(**kw) -> ChainSpec:
+    spec = local_spec()
+    spec.block_time_ms = 50
+    spec.finality_period = 4
+    for k, v in kw.items():
+        setattr(spec, k, v)
+    return spec
+
+
+def make_node(spec, authority) -> NodeService:
+    return NodeService(spec, authority=authority,
+                       registry=scoped_registry())
+
+
+def slot_owned_by(svc: NodeService, name: str, start: int) -> int:
+    slot = start
+    while svc._slot_author(slot) != name:
+        slot += 1
+    return slot
+
+
+class Lockstep:
+    """Three validator nodes driven deterministically, no threads: for
+    each slot the owner authors and the others import — the replicated
+    state machine in miniature."""
+
+    def __init__(self):
+        self.spec = make_spec()
+        self.nodes = {
+            v: make_node(self.spec, v) for v in self.spec.validators
+        }
+        self.slot = 0
+
+    def step(self) -> Block:
+        self.slot += 1
+        any_node = next(iter(self.nodes.values()))
+        author = any_node._slot_author(self.slot)
+        rec = self.nodes[author].produce_block(slot=self.slot)
+        assert rec is not None
+        block = self.nodes[author].block_store[rec.hash]
+        for name, node in self.nodes.items():
+            if name != author:
+                node.import_block(block)
+        return block
+
+    def run(self, blocks: int):
+        for _ in range(blocks):
+            self.step()
+
+    def relay_finality(self):
+        """One gossip round: every validator votes, votes cross, the
+        resulting justification crosses."""
+        votes = [n._finality_tick() for n in self.nodes.values()]
+        votes = [v for v in votes if v is not None]
+        for v in votes:
+            for n in self.nodes.values():
+                n.add_vote(v)
+        best = max(self.nodes.values(), key=lambda n: n.finalized_number)
+        just = best.justifications.get(best.finalized_number)
+        if just is not None:
+            for n in self.nodes.values():
+                n.handle_justification(just)
+
+
+class TestImportVerification:
+    def test_lockstep_convergence(self):
+        net = Lockstep()
+        net.run(5)
+        hashes = {n.head_hash for n in net.nodes.values()}
+        states = {n.state_hash() for n in net.nodes.values()}
+        assert len(hashes) == 1 and len(states) == 1
+        assert all(
+            n.rt.state.block_number == 5 for n in net.nodes.values()
+        )
+
+    def test_forged_author_rejected(self):
+        spec = make_spec()
+        a = make_node(spec, "alice")
+        b = make_node(spec, "bob")
+        # bob authors a block at a slot the schedule gives to alice
+        slot = slot_owned_by(a, "alice", 1)
+        forged = Block(
+            number=1, slot=slot, parent=b.genesis, author="bob",
+            state_hash="00" * 32,
+        ).sign(dev_sk("bob", spec.chain_id), b.genesis)
+        with pytest.raises(BlockImportError, match="wrong author"):
+            a.import_block(forged)
+        # right author name, wrong key underneath
+        forged2 = Block(
+            number=1, slot=slot, parent=b.genesis, author="alice",
+            state_hash="00" * 32,
+        ).sign(dev_sk("bob", spec.chain_id), b.genesis)
+        with pytest.raises(BlockImportError, match="signature"):
+            a.import_block(forged2)
+        assert a.rt.state.block_number == 0  # nothing applied
+
+    def test_state_hash_mismatch_rolls_back(self):
+        spec = make_spec()
+        a = make_node(spec, "alice")
+        b = make_node(spec, "bob")
+        slot = slot_owned_by(a, "alice", 1)
+        rec = a.produce_block(slot=slot)
+        blk = a.block_store[rec.hash]
+        tampered = Block.from_json(blk.to_json())
+        tampered.state_hash = "11" * 32
+        tampered.sign(dev_sk("alice", spec.chain_id), a.genesis)
+        h_before = b.state_hash()
+        with pytest.raises(BlockImportError, match="state hash"):
+            b.import_block(tampered)
+        assert b.rt.state.block_number == 0
+        assert b.state_hash() == h_before
+        # the honest block still imports afterwards
+        assert b.import_block(blk) is not None
+        assert b.state_hash() == a.state_hash()
+
+    def test_tampered_extrinsics_break_signature(self):
+        """The author signs the extrinsic root: swapping the body in
+        transit invalidates the block signature."""
+        from cess_tpu.chain.types import TOKEN
+        from cess_tpu.node import Extrinsic
+
+        spec = make_spec()
+        a = make_node(spec, "alice")
+        b = make_node(spec, "bob")
+        ext = Extrinsic(
+            signer="miner-0", module="sminer", call="regnstk",
+            args=["ben", {"hex": b"p".hex()}, 8000 * TOKEN], nonce=0,
+        ).sign(dev_sk("miner-0", spec.chain_id), a.genesis)
+        a.submit_extrinsic(ext)
+        rec = a.produce_block(slot=slot_owned_by(a, "alice", 1))
+        blk = a.block_store[rec.hash]
+        stripped = Block.from_json(blk.to_json())
+        stripped.extrinsics = []  # drop the body, keep the signature
+        with pytest.raises(BlockImportError):
+            b.import_block(stripped)
+        assert b.import_block(blk) is not None
+        assert "miner-0" in b.rt.sminer.miner_items
+
+    def test_forged_fork_block_cannot_displace_head(self):
+        """Fork-choice fields (number/slot/parent) are attacker-chosen:
+        an unauthenticated announce that would win fork choice must not
+        knock the genuine head off (the rollback is transactional)."""
+        spec = make_spec()
+        a = make_node(spec, "alice")
+        b = make_node(spec, "bob")
+        sa = slot_owned_by(a, "alice", 10)
+        rec = a.produce_block(slot=sa)
+        blk = a.block_store[rec.hash]
+        b.import_block(blk)
+        head_before = b.head_hash
+        state_before = b.state_hash()
+        # same height, same parent, lower slot → would win fork choice;
+        # signed by a non-validator key
+        forged = Block(
+            number=1, slot=1, parent=blk.parent, author="alice",
+            state_hash=blk.state_hash, extrinsics=[],
+        ).sign(dev_sk("mallory", spec.chain_id), b.genesis)
+        with pytest.raises(BlockImportError):
+            b.import_block(forged)
+        assert b.head_hash == head_before
+        assert b.state_hash() == state_before
+        assert b.rt.state.block_number == 1
+        # a validator-signed fork block that fails the slot-author check
+        # post-rollback reinstates the old head too
+        s2 = slot_owned_by(a, "alice", 1)
+        if s2 < sa:
+            forged2 = Block(
+                number=1, slot=s2, parent=blk.parent, author="bob",
+                state_hash=blk.state_hash, extrinsics=[],
+            ).sign(dev_sk("bob", spec.chain_id), b.genesis)
+            with pytest.raises(BlockImportError):
+                b.import_block(forged2)
+            assert b.head_hash == head_before
+            assert b.state_hash() == state_before
+
+    def test_replayed_extrinsic_fails_deterministically(self):
+        """A malicious author re-including an already-applied signed
+        extrinsic gets a deterministic failed receipt on every replica
+        (the consensus nonce gate), never a second execution."""
+        from cess_tpu.chain.types import TOKEN
+        from cess_tpu.node import Extrinsic
+
+        spec = make_spec()
+        a = make_node(spec, "alice")
+        b = make_node(spec, "bob")
+        ext = Extrinsic(
+            signer="miner-0", module="sminer", call="regnstk",
+            args=["ben", {"hex": b"p".hex()}, 8000 * TOKEN], nonce=0,
+        ).sign(dev_sk("miner-0", spec.chain_id), a.genesis)
+        a.submit_extrinsic(ext)
+        s1 = slot_owned_by(a, "alice", 1)
+        rec1 = a.produce_block(slot=s1)
+        b.import_block(a.block_store[rec1.hash])
+        assert b.rt.state.nonces["miner-0"] == 1
+        # the attacker forces the spent extrinsic into its own pool
+        # (bypassing intake gating, which an author controls anyway)
+        # and authors a block replaying it
+        a.pool._ready.append(ext)
+        s2 = slot_owned_by(a, "alice", s1 + 1)
+        rec2 = a.produce_block(slot=s2)
+        assert rec2.receipts[0]["ok"] is False
+        assert "stale nonce" in rec2.receipts[0]["error"]
+        # replicas re-execute to the same failed receipt and state
+        imported = b.import_block(a.block_store[rec2.hash])
+        assert imported is not None
+        assert imported.receipts[0]["ok"] is False
+        assert b.state_hash() == a.state_hash()
+        assert b.rt.state.nonces["miner-0"] == 1  # applied exactly once
+
+    def test_unjustified_warp_anchor_rejected(self):
+        """restore_checkpoint refuses a blob whose head is merely
+        validator-signed: without a 2/3 justification one compromised
+        validator could fabricate an arbitrary chain state."""
+        spec = make_spec()
+        a = make_node(spec, "alice")
+        slot = 0
+        for _ in range(4):
+            slot = slot_owned_by(a, "alice", slot + 1)
+            a.produce_block(slot=slot)
+        blob = a.export_state()
+        head = a.block_store[a.head_hash]
+        late = make_node(spec, "bob")
+        assert late.restore_checkpoint(blob, head, None) is False
+        assert late.rt.state.block_number == 0
+        # with a genuine 2/3 justification the same anchor is accepted
+        from cess_tpu.node.sync import finality_payload
+
+        bh = head.hash(a.genesis)
+        payload = finality_payload(a.genesis, 4, bh)
+        votes = {
+            v: bls.sign(dev_sk(v, spec.chain_id), payload).hex()
+            for v in ("alice", "bob")
+        }
+        just = Justification.from_votes(4, bh, votes)
+        assert late.restore_checkpoint(blob, head, just) is True
+        assert late.finalized_number == 4
+        assert late.state_hash() == a.state_hash()
+
+    def test_same_height_fork_choice_converges(self):
+        spec = make_spec()
+        a = make_node(spec, "alice")
+        b = make_node(spec, "bob")
+        sa = slot_owned_by(a, "alice", 1)
+        sb = slot_owned_by(b, "bob", sa + 1)
+        rec_a = a.produce_block(slot=sa)
+        rec_b = b.produce_block(slot=sb)
+        block_a = a.block_store[rec_a.hash]
+        block_b = b.block_store[rec_b.hash]
+        # earlier slot wins on both replicas
+        assert a.import_block(block_b) is None      # ours is earlier
+        assert a.head_hash == rec_a.hash
+        b.import_block(block_a)                      # reorg to alice's
+        assert b.head_hash == rec_a.hash
+        assert b.m_reorgs.value == 1
+        assert a.state_hash() == b.state_hash()
+
+
+class TestFinality:
+    def test_aggregate_justification_finalizes(self):
+        net = Lockstep()
+        net.run(4)
+        net.relay_finality()
+        for n in net.nodes.values():
+            assert n.finalized_number == 4
+            just = n.justifications[4]
+            assert quorum(len(just.signers), len(net.spec.validators))
+            assert verify_justification(
+                just, n.genesis, net.spec.validators, n.keys
+            )
+        net.run(4)
+        net.relay_finality()
+        assert all(
+            n.finalized_number == 8 for n in net.nodes.values()
+        )
+
+    def test_forged_justification_rejected(self):
+        net = Lockstep()
+        net.run(4)
+        net.relay_finality()
+        node = net.nodes["alice"]
+        target = node.block_by_number[4]
+        bh = target.hash(node.genesis)
+
+        # (a) signatures under the wrong keys
+        from cess_tpu.node.sync import finality_payload
+
+        payload = finality_payload(node.genesis, 8, bh)
+        fake_sigs = {
+            v: bls.sign(dev_sk("mallory", "x"), payload).hex()
+            for v in ("alice", "bob")
+        }
+        forged = Justification.from_votes(8, bh, fake_sigs)
+        assert node.handle_justification(forged) is False
+
+        # (b) sub-quorum signer set, genuine signatures
+        net.run(4)
+        bh8 = node.block_by_number[8].hash(node.genesis)
+        payload8 = finality_payload(node.genesis, 8, bh8)
+        one = {"alice": bls.sign(
+            dev_sk("alice", net.spec.chain_id), payload8).hex()}
+        assert node.handle_justification(
+            Justification.from_votes(8, bh8, one)
+        ) is False
+
+        # (c) non-validator signers
+        outsider = {
+            "alice": bls.sign(
+                dev_sk("alice", net.spec.chain_id), payload8).hex(),
+            "mallory": bls.sign(dev_sk("mallory", "x"), payload8).hex(),
+        }
+        assert node.handle_justification(
+            Justification.from_votes(8, bh8, outsider)
+        ) is False
+        assert node.finalized_number == 4  # untouched by all three
+
+    def test_early_justification_applies_after_import(self):
+        """A justification gossiped ahead of its block (gossip outruns
+        the import path) is buffered and applied when the block lands,
+        not dropped — at exactly 2/3 quorum no further votes would ever
+        rebuild it."""
+        net = Lockstep()
+        net.run(3)
+        late = make_node(net.spec, "dave")  # observer, not a validator
+        for n in range(1, 4):
+            late.import_block(net.nodes["alice"].block_by_number[n])
+        blk4 = net.step()
+        net.relay_finality()
+        just = net.nodes["alice"].justifications[4]
+        # justification arrives first: verified, buffered, not applied
+        assert late.handle_justification(just) is False
+        assert late.finalized_number == 0
+        # the block lands; the buffered justification finalizes it
+        late.import_block(blk4)
+        assert late.finalized_number == 4
+        assert late.justifications[4].signers == just.signers
+
+    def test_no_revote_after_boundary_block_retracted(self):
+        """A validator that voted for a finality-boundary block whose
+        hash is then retracted by fork choice must NOT vote again at
+        that height: its first vote may already sit in a forming
+        quorum, and a second vote for the replacement hash lets two
+        conflicting justifications finalize the same height on
+        different nodes (equivocation → permanent chain split).  The
+        boundary lapses; the next period finalizes normally."""
+        spec = make_spec()
+        a = make_node(spec, "alice")
+        b = make_node(spec, "bob")
+        c = make_node(spec, "charlie")
+        # alice authors blocks 1-3; everyone imports
+        slot = 0
+        for _ in range(3):
+            slot = slot_owned_by(a, "alice", slot + 1)
+            rec = a.produce_block(slot=slot)
+            blk = a.block_store[rec.hash]
+            b.import_block(blk)
+            c.import_block(blk)
+        # two competing empty blocks at height 4 (the finality
+        # boundary): charlie's at a lower slot wins fork choice
+        s_c = slot_owned_by(c, "charlie", slot + 1)
+        s_a = slot_owned_by(a, "alice", s_c + 1)
+        rec_a = a.produce_block(slot=s_a)
+        blk_a = a.block_store[rec_a.hash]
+        rec_c = c.produce_block(slot=s_c)
+        blk_c = c.block_store[rec_c.hash]
+        # bob imports alice's block first and votes for it
+        b.import_block(blk_a)
+        v1 = b._finality_tick()
+        assert v1 is not None and v1.number == 4
+        # charlie's lower-slot block displaces the head
+        assert b.import_block(blk_c) is not None
+        assert b.head_hash == blk_c.hash(b.genesis)
+        # bob already voted at height 4 — no second vote (equivocation)
+        assert b._finality_tick() is None
+        # the lapsed boundary heals at the next period: advance to 8
+        # and the tick targets the new boundary
+        slot = max(s_a, s_c)
+        while b.rt.state.block_number < 8:
+            slot = slot_owned_by(c, "charlie", slot + 1)
+            rec = c.produce_block(slot=slot)
+            b.import_block(c.block_store[rec.hash])
+        v2 = b._finality_tick()
+        assert v2 is not None and v2.number == 8
+
+    def test_duplicate_and_bad_votes_ignored(self):
+        net = Lockstep()
+        net.run(4)
+        node = net.nodes["alice"]
+        vote = node._finality_tick()
+        assert vote is not None
+        assert node.add_vote(vote) is True  # idempotent re-add
+        forged = type(vote)(
+            number=vote.number, block_hash=vote.block_hash,
+            voter="bob", signature=vote.signature,  # alice's sig as bob
+        )
+        assert node.add_vote(forged) is False
+
+    def test_equivocating_voter_evicted(self):
+        """A validator signing two different hashes at one height is a
+        proven equivocator: its weight is purged from every tally at
+        that height and further votes from it are refused, so one
+        Byzantine validator cannot contribute to two conflicting 2/3
+        quorums.  An UNVERIFIED conflicting vote (wrong key) must never
+        evict an honest validator's weight — only a second valid
+        signature is proof."""
+        from cess_tpu.node.sync import Vote, finality_payload
+
+        net = Lockstep()
+        net.run(4)
+        node = net.nodes["alice"]
+        bh = node.block_by_number[4].hash(node.genesis)
+        fake_bh = "ab" * 32
+        sk_bob = dev_sk("bob", net.spec.chain_id)
+
+        def bob_vote(h, sk=sk_bob):
+            payload = finality_payload(node.genesis, 4, h)
+            return Vote(number=4, block_hash=h, voter="bob",
+                        signature=bls.sign(sk, payload).hex())
+
+        assert node.add_vote(bob_vote(bh)) is True
+        # conflicting vote under the WRONG key: rejected without
+        # evicting bob's genuine weight
+        assert node.add_vote(
+            bob_vote(fake_bh, sk=dev_sk("mallory", "x"))) is False
+        assert "bob" in node._votes[(4, bh)]
+        # conflicting vote under bob's real key: proven equivocation
+        assert node.add_vote(bob_vote(fake_bh)) is False
+        assert "bob" not in node._votes[(4, bh)]
+        assert node.add_vote(bob_vote(bh)) is False  # banned at height
+        # the honest 2/3 still finalizes without the equivocator
+        for n in net.nodes.values():
+            v = n._finality_tick()
+            if v is not None:
+                node.add_vote(v)
+        assert node.finalized_number == 4
+        assert "bob" not in node.justifications[4].signers
+
+
+class TestCatchUp:
+    def seed_chain(self, spec, blocks: int) -> NodeService:
+        """Single-validator chain (only 'alice' in the set) so one node
+        can author every slot deterministically."""
+        node = make_node(spec, "alice")
+        slot = 0
+        while node.rt.state.block_number < blocks:
+            slot += 1
+            if node._slot_author(slot) == "alice":
+                node.produce_block(slot=slot)
+        return node
+
+    @pytest.fixture()
+    def single_validator_spec(self):
+        spec = make_spec()
+        spec.validators = ["alice"]
+        return spec
+
+    def test_block_replay_catch_up(self, single_validator_spec):
+        spec = single_validator_spec
+        head = self.seed_chain(spec, 6)
+        server = RpcServer(head, port=0)
+        server.start()
+        try:
+            follower = make_node(spec, "bob")
+            sync = SyncManager(
+                follower, [(server.host, server.port)], checkpoint_gap=50
+            )
+            imported = sync.catch_up()
+            assert imported == 6
+            assert follower.head_hash == head.head_hash
+            assert follower.state_hash() == head.state_hash()
+            assert follower.m_catchup.value == 0  # replay, no warp
+        finally:
+            server.stop()
+
+    def test_checkpoint_bootstrap_catch_up(self, single_validator_spec):
+        spec = single_validator_spec
+        head = self.seed_chain(spec, 8)
+        # a warp anchor is only trusted when covered by a justification:
+        # finalize block 8 (single validator — its own vote is quorum)
+        assert head._finality_tick() is not None
+        assert head.finalized_number == 8
+        server = RpcServer(head, port=0)
+        server.start()
+        try:
+            late = make_node(spec, "bob")
+            sync = SyncManager(
+                late, [(server.host, server.port)], checkpoint_gap=3
+            )
+            sync.catch_up()
+            assert late.m_catchup.value == 1  # warp-synced
+            assert late.rt.state.block_number == 8
+            assert late.state_hash() == head.state_hash()
+            assert late.finalized_number == 8  # anchor arrived finalized
+            # and it keeps following blocks produced after the warp
+            slot = head.slot
+            while head.rt.state.block_number < 10:
+                slot += 1
+                if head._slot_author(slot) == "alice":
+                    head.produce_block(slot=slot)
+            assert sync.catch_up() == 2
+            assert late.head_hash == head.head_hash
+        finally:
+            server.stop()
+
+    def test_longest_chain_fork_resolution(self, single_validator_spec):
+        """A node stranded on a shorter fork rewinds to the common
+        ancestor and adopts the longer peer chain."""
+        spec = single_validator_spec
+        shared = self.seed_chain(spec, 3)
+        # clone the 3-block prefix onto a second node via replay
+        other = make_node(spec, "bob")
+        for n in range(1, 4):
+            other.import_block(shared.block_by_number[n])
+        # shared advances 3 more; the follower rewinds one block, so it
+        # sits on a strict prefix with a stale head (the post-reorg /
+        # post-crash shape catch-up must recover from)
+        slot = shared.slot
+        while shared.rt.state.block_number < 6:
+            slot += 1
+            if shared._slot_author(slot) == "alice":
+                shared.produce_block(slot=slot)
+        assert other.reorg_to(2)
+        assert other.rt.state.block_number == 2
+        assert other.head_hash == shared.block_by_number[2].hash(
+            shared.genesis
+        )
+        server = RpcServer(shared, port=0)
+        server.start()
+        try:
+            sync = SyncManager(
+                other, [(server.host, server.port)], checkpoint_gap=50
+            )
+            assert sync.catch_up() == 4
+            assert other.head_hash == shared.head_hash
+            assert other.state_hash() == shared.state_hash()
+        finally:
+            server.stop()
+
+    def test_announce_over_rpc_imports(self, single_validator_spec):
+        spec = single_validator_spec
+        author = self.seed_chain(spec, 1)
+        follower = make_node(spec, "bob")
+        server = RpcServer(follower, port=0)
+        server.start()
+        try:
+            from cess_tpu.node.rpc import rpc_call
+
+            blk = author.block_store[author.head_hash]
+            result = rpc_call(
+                server.host, server.port, "sync_announce", [blk.to_json()]
+            )
+            assert result == "imported"
+            assert follower.head_hash == author.head_hash
+            status = rpc_call(server.host, server.port, "sync_status", [])
+            assert status["number"] == 1
+            assert status["hash"] == author.head_hash
+        finally:
+            server.stop()

@@ -1,0 +1,261 @@
+"""Acceptance e2e: a CLI-launched 3-process testnet with rotating
+authorship converges to one state hash, finalizes blocks with 2/3
+BLS-aggregate justifications, survives killing + rejoining one node
+(checkpoint catch-up to head), and completes a full challenge → prove
+→ verify → reward audit round driven entirely by the live services'
+offchain workers, with miner/TEE role clients speaking RPC.
+
+Everything chain-side happens inside the three `python -m cess_tpu
+run` processes; this file only plays the external roles (miner, TEE)
+over the wire — zero harness calls into the runtime.
+
+Sorts last (zz) so a tier-1 timeout truncates it, not the broad suite."""
+
+import json
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from cess_tpu.node.chain_spec import _spec
+from cess_tpu.node.client import MinerClient, TeeClient
+from cess_tpu.node.rpc import RpcError, rpc_call
+from cess_tpu.ops.podr2 import Podr2Params
+from cess_tpu.chain.types import TOKEN
+
+PARAMS = Podr2Params(n=8, s=4)
+BLOCK_MS = 500
+HOST = "127.0.0.1"
+
+
+def free_ports(n: int) -> list[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind((HOST, 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def build_spec_file(tmp_path) -> str:
+    spec = _spec(
+        "e2e", "CESS-TPU Sync E2E",
+        accounts=["alice", "bob", "charlie", "miner-0",
+                  "tee-stash", "tee-ctrl"],
+        validators=["alice", "bob", "charlie"],
+        block_time_ms=BLOCK_MS,
+    )
+    spec.finality_period = 4
+    spec.genesis = {
+        "one_day_block": 20,          # ~50% challenge trigger per block
+        "podr2_chunk_count": PARAMS.n,
+        "era_duration_blocks": 4,     # fund the reward pot early
+    }
+    path = tmp_path / "e2e-spec.json"
+    path.write_text(spec.to_json())
+    return str(path)
+
+
+def launch(spec_path: str, authority: str, port: int,
+           peer_ports: list[int]) -> subprocess.Popen:
+    peers = ",".join(f"{HOST}:{p}" for p in peer_ports)
+    return subprocess.Popen(
+        [sys.executable, "-m", "cess_tpu", "run",
+         "--chain", spec_path, "--rpc-port", str(port),
+         "--authority", authority, "--peers", peers,
+         "--checkpoint-gap", "3"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        cwd="/root/repo", text=True,
+    )
+
+
+def wait_rpc(port: int, timeout: float = 120.0) -> None:
+    t0 = time.monotonic()
+    while True:
+        try:
+            rpc_call(HOST, port, "system_name", [], timeout=2.0)
+            return
+        except (OSError, RpcError):
+            if time.monotonic() - t0 > timeout:
+                raise TimeoutError(f"node on port {port} never came up")
+            time.sleep(0.5)
+
+
+def status(port: int) -> dict:
+    return rpc_call(HOST, port, "sync_status", [], timeout=5.0)
+
+
+def wait_for(pred, timeout: float, what: str, poll: float = 0.4):
+    t0 = time.monotonic()
+    while True:
+        value = pred()
+        if value:
+            return value
+        if time.monotonic() - t0 > timeout:
+            raise TimeoutError(f"timed out waiting for {what}")
+        time.sleep(poll)
+
+
+class TestThreeProcessTestnet:
+    def test_full_network_lifecycle(self, tmp_path):
+        spec_path = build_spec_file(tmp_path)
+        ports = free_ports(3)
+        validators = ["alice", "bob", "charlie"]
+        procs = {}
+        try:
+            for v, port in zip(validators, ports):
+                procs[v] = launch(
+                    spec_path, v, port, [p for p in ports if p != port]
+                )
+            for port in ports:
+                wait_rpc(port)
+
+            # ---- liveness: every node advances past genesis
+            wait_for(
+                lambda: min(status(p)["number"] for p in ports) >= 2,
+                120, "all nodes past block 2",
+            )
+
+            # ---- roles register over RPC against node 0 (alice)
+            port0 = ports[0]
+            tee = TeeClient("tee-ctrl", chain_id="e2e", port=port0,
+                            timeout=60.0)
+            stash = TeeClient("tee-stash", chain_id="e2e", port=port0,
+                              timeout=60.0)
+            miner = MinerClient("miner-0", chain_id="e2e", port=port0,
+                                timeout=60.0)
+            stash.submit("staking", "bond", "tee-ctrl", 100_000 * TOKEN)
+            tee.register("tee-stash")
+            wait_for(
+                lambda: rpc_call(HOST, port0, "teeWorker_podr2Key", [],
+                                 timeout=5.0) is not None,
+                60, "tee registration on chain",
+            )
+            miner.register("miner-0-ben", b"peer", 8000 * TOKEN)
+            miner.create_fillers(tee, 2, PARAMS)
+
+            def has_idle_space():
+                # the register extrinsic may not be in a block yet, in
+                # which case minerInfo errors rather than returning 0
+                try:
+                    return miner.info()["idle_space"] > 0
+                except RpcError:
+                    return False
+
+            wait_for(has_idle_space, 60, "filler report on chain")
+
+            # ---- the live OCWs generate + quorum-commit a challenge
+            def challenged():
+                snap = miner.call("audit_challengeSnapshot")
+                return snap is not None and any(
+                    s["miner"] == "miner-0"
+                    for s in snap["miner_snapshot_list"]
+                )
+
+            wait_for(challenged, 120, "OCW-driven challenge commit")
+
+            # ---- miner proves, TEE verifies, reward lands
+            from cess_tpu.proof import CpuBackend
+
+            backend = CpuBackend()
+            items = miner.answer_challenge(backend, PARAMS)
+            assert items is not None
+
+            def verified():
+                return tee.verify_missions(
+                    backend, PARAMS, {"miner-0": items}
+                )
+
+            results = wait_for(verified, 90, "verify mission assigned")
+            assert results == {"miner-0": (True, True)}
+            reward = wait_for(
+                lambda: (miner.call("sminer_rewardInfo", "miner-0")
+                         or {}).get("currently_available_reward", 0),
+                60, "audit reward order",
+            )
+            assert reward > 0
+
+            # ---- finality: 2/3 BLS-aggregate justifications advance
+            fin = wait_for(
+                lambda: min(
+                    status(p)["finalized"]["number"] for p in ports
+                ),
+                90, "finalized head on every node",
+            )
+            assert fin >= 4 and fin % 4 == 0
+
+            # ---- convergence: one block/state hash at finalized height
+            blocks = [
+                rpc_call(HOST, p, "sync_block", [fin], timeout=5.0)
+                for p in ports
+            ]
+            state_hashes = {b["block"]["stateHash"] for b in blocks}
+            sigs = {b["block"]["sig"] for b in blocks}
+            assert len(state_hashes) == 1 and len(sigs) == 1
+            justs = [b["justification"] for b in blocks
+                     if b["justification"]]
+            assert justs and all(
+                len(j["signers"]) * 3 >= 2 * 3 for j in justs
+            )
+
+            # ---- kill charlie; the remaining 2/3 keep finalizing
+            procs["charlie"].send_signal(signal.SIGKILL)
+            procs["charlie"].wait(timeout=30)
+            head_after_kill = status(port0)["number"]
+            wait_for(
+                lambda: status(port0)["number"] >= head_after_kill + 4,
+                90, "chain advances without charlie",
+            )
+
+            # ---- rejoin: fresh process warp-syncs from a checkpoint
+            # and catches up to head
+            procs["charlie"] = launch(
+                spec_path, "charlie", ports[2],
+                [ports[0], ports[1]],
+            )
+            wait_rpc(ports[2])
+
+            def caught_up():
+                a, c = status(port0), status(ports[2])
+                if a["number"] - c["number"] > 2:
+                    return False
+                common = min(a["number"], c["number"]) - 1
+                if common < 1:
+                    return False
+                ba = rpc_call(HOST, port0, "sync_block", [common],
+                              timeout=5.0)
+                try:
+                    bc = rpc_call(HOST, ports[2], "sync_block", [common],
+                                  timeout=5.0)
+                except RpcError:
+                    return False
+                return (ba["block"]["stateHash"]
+                        == bc["block"]["stateHash"])
+
+            wait_for(caught_up, 150, "charlie catch-up to head", poll=1.0)
+
+            # rejoined node resumes finalizing too
+            fin0 = status(ports[2])["finalized"]["number"]
+            wait_for(
+                lambda: status(ports[2])["finalized"]["number"]
+                >= max(fin0, fin) + 4,
+                90, "charlie resumes finality",
+            )
+            miner.close()
+            tee.close()
+            stash.close()
+        finally:
+            for proc in procs.values():
+                if proc.poll() is None:
+                    proc.kill()
+            for proc in procs.values():
+                try:
+                    proc.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    pass
