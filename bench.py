@@ -39,6 +39,21 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def enable_compile_cache() -> None:
+    """Persistent XLA compile cache for bench runs (tests get this from
+    tests/conftest.py; the bench previously ran cold, so a first run
+    paid minutes of silent craft/verify compiles that read as a
+    regression — BENCH_r04's 278 s proofgen).  Must run before the
+    first jit compiles anything."""
+    import jax
+
+    cache_dir = os.environ.get("JAX_COMPILE_CACHE", "/tmp/jax_cache_cess")
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
+    log(f"compile cache: {cache_dir}")
+
+
 # ---------------------------------------------------------------- RS part
 
 
@@ -141,6 +156,25 @@ def bench_verify(n_proofs: int) -> tuple[float, float]:
     per_proof = (t_full - t_half) / (n_proofs - n_proofs // 2)
     log(f"verify: B={n_proofs} in {t_full:.2f}s; B={n_proofs // 2} in "
         f"{t_half:.2f}s; marginal {per_proof * 1000:.1f} ms/proof")
+
+    # Per-stage attribution on a SEPARATE profiled pass (the stage
+    # boundaries block the dispatch pipeline, so the timed runs above
+    # stay clean): where a regression lives can no longer ship
+    # unmeasured.  fused=False is load-bearing: only the staged
+    # (non-fused) pipeline is instrumented, and on a real TPU the auto
+    # gate would otherwise route to the fused single-program path and
+    # log an empty breakdown.
+    prof = XlaBackend(profile_stages=True, fused=False)
+    podr2.chunk_point.cache_clear()
+    verdicts = prof.verify_batch(pk, items, b"bench-seed", params)
+    assert all(verdicts)
+    total = sum(prof.stage_seconds.values()) or 1.0
+    log("stages (profiled pass, B=%d): " % n_proofs + ", ".join(
+        f"{k}={v:.2f}s ({100 * v / total:.0f}%)"
+        for k, v in sorted(
+            prof.stage_seconds.items(), key=lambda kv: -kv[1]
+        )
+    ))
     return t_full, per_proof
 
 
@@ -148,6 +182,7 @@ def bench_verify(n_proofs: int) -> tuple[float, float]:
 
 
 def main() -> None:
+    enable_compile_cache()
     n_proofs = int(os.environ.get("BENCH_PROOFS", "1024"))
     # power of two: the grouped MSM pads the batch to one anyway, and the
     # marginal-slope calculation below assumes the padded lanes scale

@@ -295,6 +295,9 @@ def _dataclass_registry() -> dict[str, type]:
 # v1: bare canonical encoding, no header (the original format — still
 #     accepted on read).
 # v2: header introduced; payload layout unchanged.
+# v3: VRF consensus state on the rrsc pallet (epoch-randomness
+#     accumulator + fold count, cess_tpu/consensus) — epoch randomness
+#     became accumulated consensus state instead of a derived snapshot.
 #
 # MIGRATIONS[v] upgrades a decoded v payload dict to v+1; restore runs
 # the chain v → FORMAT_VERSION, so any supported older blob loads into
@@ -303,7 +306,7 @@ def _dataclass_registry() -> dict[str, type]:
 # entry here instead of breaking old fixtures.
 
 MAGIC = b"CESSCKPT"
-FORMAT_VERSION = 2
+FORMAT_VERSION = 3
 
 
 def _migrate_v1_to_v2(data: dict) -> dict:
@@ -312,7 +315,18 @@ def _migrate_v1_to_v2(data: dict) -> dict:
     return data
 
 
-MIGRATIONS = {1: _migrate_v1_to_v2}
+def _migrate_v2_to_v3(data: dict) -> dict:
+    """Pre-VRF blobs carry no accumulator: seed it empty with a zero
+    fold count, which rrsc.rotate_epoch reads as "no VRF-bearing blocks
+    yet" and keeps the old hash-chain rotation until outputs arrive."""
+    rrsc = data.get("rrsc")
+    if isinstance(rrsc, dict):
+        rrsc.setdefault("vrf_accumulator", bytes(32))
+        rrsc.setdefault("vrf_fold_count", 0)
+    return data
+
+
+MIGRATIONS = {1: _migrate_v1_to_v2, 2: _migrate_v2_to_v3}
 
 
 # ---------------------------------------------------------------- API

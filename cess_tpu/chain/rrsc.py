@@ -1,28 +1,38 @@
-"""RRSC stand-in: credit-weighted validator rotation + slot authorship.
+"""RRSC pallet: credit-weighted rotation + VRF epoch randomness.
 
 The reference's consensus is RRSC (Random Rotational Selection, a BABE
 fork living in the forked substrate — SURVEY.md §2 external components:
 `pallet_rrsc`/`cessc-consensus-rrsc`, runtime alias at
-runtime/src/lib.rs:1503).  Its two protocol-visible capabilities are:
+runtime/src/lib.rs:1503).  Its protocol-visible capabilities:
 
  * validator selection that folds TEE service reputation into the
    election (the `ValidatorCredits` trait implemented by
    scheduler-credit, c-pallets/scheduler-credit/src/lib.rs:242-251);
- * slot-based block authorship driven by per-epoch randomness (the
-   `ParentBlockRandomness` the audit/file-bank pallets also consume,
-   runtime/src/lib.rs:1003,1069).
+ * slot-based authorship driven by per-epoch randomness, with each
+   block's VRF output accumulated into the NEXT epoch's randomness
+   (the `ParentBlockRandomness` feed, runtime/src/lib.rs:1003,1069).
 
-This pallet re-expresses both against the framework's deterministic
-block loop: `rotate_epoch` runs the credit-weighted election
-(staking.elect × scheduler_credit.credits) and refreshes the epoch
-randomness; `slot_author` deterministically draws the block author from
-the active set, stake-weighted, from (epoch randomness, slot).  The
-draw depends only on on-chain state, so every replica computes the
-same author for a slot — node/sync.py's import verification leans on
-this (`author == slot_author(block.slot)` evaluated against the parent
-state), and node/service.py's wall-clock slot loop turns it into a
-live rotating-authorship network; chain/node.py still simulates the
-multi-role protocol in-process for tests.
+This pallet owns the on-chain consensus state for both:
+
+  `rotate_epoch`      runs the credit-weighted election (staking.elect ×
+                      scheduler_credit.credits) and pins the new epoch's
+                      randomness from the VRF accumulator;
+  `fold_vrf_output`   folds one block's verified VRF output into the
+                      accumulator — called by the node service exactly
+                      once per block, by author and importer alike, so
+                      the accumulator is replicated state (covered by
+                      chain/checkpoint.py's state hash and snapshot,
+                      blob format v3);
+  `slot_author`       the deterministic stake-weighted draw from
+                      (epoch randomness, slot) — the SECONDARY-author
+                      fallback of the claim ladder
+                      (cess_tpu/consensus/engine.py); primary claims
+                      are won by the VRF threshold instead.
+
+Runtimes that never fold an output (the in-process protocol sims of
+chain/node.py drive the runtime without headers) keep the pre-VRF
+behavior: rotation falls back to the parent-block randomness hash
+chain, so their determinism contract is unchanged.
 """
 
 from __future__ import annotations
@@ -49,12 +59,20 @@ class RrscPallet:
         self.max_validators = max_validators
         self.epoch_index: int = 0
         self.epoch_randomness: bytes = bytes(32)
+        # VRF output accumulator: every imported block folds its
+        # verified output here; the fold count distinguishes "no
+        # VRF-bearing blocks this epoch" (hash-chain fallback) from a
+        # genuinely accumulated epoch.
+        self.vrf_accumulator: bytes = bytes(32)
+        self.vrf_fold_count: int = 0
 
     # ------------------------------------------------------------ epochs
 
     def rotate_epoch(self) -> list[AccountId]:
         """Era-boundary rotation: elect the active set with TEE credit
-        weights and pin this epoch's randomness."""
+        weights and pin this epoch's randomness from the accumulated
+        VRF outputs (replacing the pre-VRF hash-chain snapshot; the
+        chain remains the fallback for header-less sims)."""
         # scheduler_credit.credits() is already stash-keyed (it resolves
         # controller → stash through its SchedulerStashAccountFinder,
         # the runtime/src/impls.rs:30-40 role).
@@ -65,28 +83,61 @@ class RrscPallet:
             full_credit=self.scheduler_credit.full_credit(),
         )
         self.epoch_index += 1
-        self.epoch_randomness = self.state.randomness
+        if self.vrf_fold_count > 0:
+            self.epoch_randomness = hashlib.blake2b(
+                b"rrsc/epoch" + self.epoch_index.to_bytes(8, "little")
+                + self.vrf_accumulator,
+                digest_size=32,
+            ).digest()
+        else:
+            self.epoch_randomness = self.state.randomness
+        # chain epochs: the new accumulator starts from the epoch
+        # randomness it will feed, so epochs are linked even if a whole
+        # epoch somehow passes without a block
+        self.vrf_accumulator = self.epoch_randomness
+        self.vrf_fold_count = 0
         self.state.deposit_event(
             MOD, "NewEpoch", index=self.epoch_index, validators=len(elected)
         )
         return elected
 
+    def fold_vrf_output(self, slot: int, output: bytes) -> None:
+        """Accumulate one block's verified VRF output.  Part of the
+        deterministic state transition: the author folds before
+        executing the block, the importer folds after verifying the
+        claim — both before run_blocks, so era-boundary rotations in
+        the SAME block already see this output."""
+        self.vrf_accumulator = hashlib.blake2b(
+            b"rrsc/vrf-fold" + self.vrf_accumulator
+            + slot.to_bytes(8, "little") + output,
+            digest_size=32,
+        ).digest()
+        self.vrf_fold_count += 1
+
     # ------------------------------------------------------------ slots
 
-    def slot_author(self, slot: int) -> AccountId | None:
-        """Stake-weighted deterministic author draw for a slot — the
-        rotational-selection stand-in for BABE slot claims.  Every
-        validator replica computes the same author from shared state."""
-        validators = self.staking.validators
-        if not validators:
-            return None
+    def stake_weights(self) -> tuple[list[AccountId], list[int], int]:
+        """(validators, bonded weights, total) — the one weight source
+        for both the secondary draw and the primary VRF threshold
+        (consensus/engine.py), so the two claim rungs can never
+        disagree about stake."""
+        validators = list(self.staking.validators)
         weights = []
         for v in validators:
             ledger = self.staking.ledger.get(v)
             weights.append(ledger.bonded if ledger else 1)
         if not any(weights):
             weights = [1] * len(validators)  # uniform fallback
-        total = sum(weights)
+        return validators, weights, sum(weights)
+
+    def slot_author(self, slot: int) -> AccountId | None:
+        """Stake-weighted deterministic SECONDARY author for a slot —
+        the fallback rung of the claim ladder: exactly one validator
+        per slot, derived from shared state, so every replica agrees
+        and the chain advances even when no primary VRF claim wins."""
+        validators, weights, total = self.stake_weights()
+        if not validators:
+            return None
         digest = hashlib.blake2b(
             b"rrsc/slot" + self.epoch_randomness + slot.to_bytes(8, "little"),
             digest_size=8,

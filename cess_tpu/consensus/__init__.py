@@ -1,0 +1,29 @@
+"""Consensus subsystem: BLS-VRF slot claims + batched header verification.
+
+The reference's consensus is RRSC (`cessc-consensus-rrsc`, a BABE fork):
+block authorship is earned by a VRF evaluation over (epoch randomness,
+slot) that anyone can verify from the header, and the verified VRF
+outputs accumulate into the next epoch's randomness (the
+`ParentBlockRandomness` feed the audit/file-bank pallets consume,
+reference: runtime/src/lib.rs:1003,1069).  This package re-expresses
+that machinery over the repo's existing crypto stack:
+
+  vrf.py     the BLS-VRF primitive (prove/verify over hash-to-curve +
+             pairings, ops/h2c.py + ops/bls12_381.py) and the batched
+             verification path that folds many header proofs into ONE
+             aggregate pairing (ops/bls_agg.py, optionally sharded over
+             a TPU mesh via parallel/msm.py);
+  engine.py  the slot-claim rules: primary claims below a stake-weighted
+             threshold, the secondary-author fallback so chains never
+             stall, and the claim checks block import enforces.
+
+chain/rrsc.py owns the on-chain state (epoch randomness, the VRF output
+accumulator); node/service.py wires claims into block production and
+import; node/sync.py batch-verifies header ranges during catch-up.
+docs/consensus.md records the rrsc→vrf scope-cut register.
+"""
+
+from . import engine, vrf
+from .engine import ClaimError, SlotClaim
+
+__all__ = ["engine", "vrf", "ClaimError", "SlotClaim"]

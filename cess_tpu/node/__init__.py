@@ -6,11 +6,15 @@ Re-design of the reference node (reference: node/src/{service,rpc,cli,
 command,chain_spec}.rs): the consensus-networking stack (libp2p,
 GRANDPA gossip) is re-expressed over the newline-JSON-RPC wire —
 signed extrinsics into a gossiped pool, wall-clock slot production
-with the RRSC author schedule, author-signed blocks announced and
-deterministically re-executed at import (sync.py), 2/3 BLS-aggregate
-justifications finalizing the chain, checkpoint warp-sync for
-rejoining nodes, and separate role processes speaking RPC — while the
-data-plane heavy lifting stays on the TPU backends (proof/)."""
+under provable BLS-VRF slot claims (cess_tpu/consensus: primary claims
+below a stake threshold, secondary fallback, outputs accumulated into
+epoch randomness), author-signed blocks announced and
+deterministically re-executed at import (sync.py) with header ranges
+batch-verified in one weighted pairing during catch-up, 2/3
+BLS-aggregate justifications finalizing the chain, checkpoint
+warp-sync for rejoining nodes, and separate role processes speaking
+RPC — while the data-plane heavy lifting stays on the TPU backends
+(proof/)."""
 
 from .chain_spec import ChainSpec, dev_spec, local_spec
 from .client import MinerClient, RpcClient, TeeClient, UserClient

@@ -310,6 +310,42 @@ class RpcApi:
                 "justification": None if just is None else just.to_json(),
             }
 
+        @method("sync_block_range")
+        def _sync_block_range(start: int, count: int):
+            """Consecutive held blocks from `start` (capped) with their
+            justifications — the range-batch catch-up feed
+            (sync.SyncManager._batch_import): the requester verifies
+            every signature in the range as ONE weighted pairing."""
+            from .sync import SYNC_RANGE_MAX
+
+            out = []
+            start = int(start)
+            for n in range(start, start + min(int(count), SYNC_RANGE_MAX)):
+                blk = s.block_by_number.get(n)
+                if blk is None:
+                    break
+                just = s.justifications.get(n)
+                out.append({
+                    "block": blk.to_json(),
+                    "justification": (
+                        None if just is None else just.to_json()
+                    ),
+                })
+            return out
+
+        @method("rrsc_epochInfo")
+        def _epoch_info():
+            """Epoch consensus state (cess_tpu/consensus): replicas on
+            the same chain must report identical values — asserted by
+            the testnet e2e."""
+            rrsc = s.rt.rrsc
+            return {
+                "epochIndex": rrsc.epoch_index,
+                "randomness": rrsc.epoch_randomness.hex(),
+                "accumulator": rrsc.vrf_accumulator.hex(),
+                "foldCount": rrsc.vrf_fold_count,
+            }
+
         @method("sync_checkpoint")
         def _sync_checkpoint():
             # Serve the FINALIZED anchor: a warp blob is only trusted by

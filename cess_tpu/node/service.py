@@ -33,6 +33,7 @@ from dataclasses import dataclass, field
 from ..chain.runtime import Runtime
 from ..chain.types import DispatchError
 from ..chain import checkpoint
+from ..consensus import ClaimError, engine as consensus
 from ..ops import bls12_381 as bls
 from .chain_spec import ChainSpec, dev_sk
 from .sync import (
@@ -453,6 +454,11 @@ class NodeService:
         self.m_catchup = m.Counter(
             "cess_catchup_runs", "checkpoint bootstraps during catch-up",
             reg)
+        self.m_vrf_primary = m.Counter(
+            "cess_vrf_primary_claims", "primary slot claims authored", reg)
+        self.m_vrf_secondary = m.Counter(
+            "cess_vrf_secondary_claims", "secondary slot claims authored",
+            reg)
         self.registry = reg
 
     # ------------------------------------------------------ submission
@@ -593,18 +599,34 @@ class NodeService:
                 if slot <= self.slot:
                     return None
                 self.slot = slot
-            author = self._slot_author(self.slot)
             if self.authority is None and self.sync is not None:
                 # networked but keyless: observer/RPC full node.  The
-                # dev fallback below would sign with the slot owner's
-                # derived key — forging blocks under another
+                # dev fallback below would evaluate the slot owner's
+                # derived key — forging claims under another
                 # validator's identity — so never author here.
                 return None
-            if self.authority is not None and author != self.authority:
+            # Authorship is a VRF slot claim (cess_tpu/consensus): a
+            # dedicated authority claims for itself (primary when its
+            # VRF output beats the stake threshold, secondary when the
+            # fallback schedule names it); dev mode (authority=None)
+            # claims as the slot's secondary owner, whose dev key is
+            # derivable from the spec seed.
+            author = (self.authority if self.authority is not None
+                      else self._slot_author(self.slot))
+            sk = self._author_sk(author)
+            if sk is None:
                 return None
+            claim = consensus.claim_slot(
+                self.rt.rrsc, self.genesis, author, sk, self.slot)
+            if claim is None:
+                return None  # neither primary nor secondary this slot
             parent = self.head_hash
             slot = self.slot
             exts = self.pool.drain(self.MAX_EXTRINSICS_PER_BLOCK)
+            # the output is consensus state the moment the block exists:
+            # fold BEFORE run_blocks, so an era rotation inside this very
+            # block already accumulates it (importers do the same)
+            self.rt.rrsc.fold_vrf_output(slot, claim.output)
             self.rt.run_blocks(1)
             record = BlockRecord(
                 number=self.rt.state.block_number, author=author)
@@ -614,12 +636,14 @@ class NodeService:
                 number=record.number, slot=slot, parent=parent,
                 author=author, state_hash=shash,
                 extrinsics=[e.to_json() for e in exts],
+                vrf_output=claim.output.hex(),
+                vrf_proof=claim.proof.hex(),
             )
-            sk = self._author_sk(author)
-            if sk is not None:
-                block.sign(sk, self.genesis)
+            block.sign(sk, self.genesis)
             self._commit_block(block, record, blob)
             self.m_blocks.inc()
+            (self.m_vrf_primary if claim.primary
+             else self.m_vrf_secondary).inc()
         # outside the lock: network fan-out + offchain hooks
         if self.sync is not None:
             self.sync.announce_block(block)
@@ -702,24 +726,78 @@ class NodeService:
             self.blocks.append(record)
             self.pool.prune(set(record.extrinsics), self.genesis)
 
-    def import_block(self, block: Block) -> BlockRecord | None:
+    def import_block(
+        self, block: Block, sigs_verified: bool = False
+    ) -> BlockRecord | None:
         """Verify and re-execute a peer block (the import-queue role).
 
-        Rejections (BlockImportError): unknown/wrong slot author, bad
-        author signature, non-monotone slot, invalid extrinsic
-        signatures, or a post-state hash that does not match our own
-        deterministic re-execution.  A block one past our head imports;
-        a same-height fork triggers fork choice (lower slot wins, then
-        lower hash — both replicas converge); anything further ahead
-        raises SyncGap for the caller to catch up.  Every rejection
-        bumps m_import_rejected exactly once."""
+        Rejections (BlockImportError): a slot claim that does not
+        verify for the claimed slot under the author's registered key
+        (missing/forged VRF proof, stolen output, above-threshold
+        claim by a non-secondary author), bad author signature,
+        non-monotone slot, invalid extrinsic signatures, or a
+        post-state hash that does not match our own deterministic
+        re-execution.  A block one past our head imports; a
+        same-height fork triggers fork choice (primary claim beats
+        secondary, then lower slot, then lower hash).  Replicas
+        sharing a head state always pick the same winner; replicas on
+        OPPOSITE sides of the fork rank with their own post-states, so
+        at an era-boundary fork (epoch context diverges with the fork
+        itself) both may keep their own head — the longest-chain rule
+        resolves such a standoff at the next authored block, exactly
+        as it does for any unknown-parent fork.  Anything further
+        ahead raises SyncGap for the caller to catch up.  Every
+        rejection bumps m_import_rejected
+        exactly once.  `sigs_verified=True` (the range-batch catch-up
+        path, node/sync.py) skips the pairing work — the caller
+        already verified every signature in one weighted batch — but
+        every structural and state check still runs."""
         try:
-            return self._import_block_inner(block)
+            return self._import_block_inner(block, sigs_verified)
         except BlockImportError:
             self.m_import_rejected.inc()
             raise
 
-    def _import_block_inner(self, block: Block) -> BlockRecord | None:
+    def _claim_rank(self, block: Block) -> int:
+        """Fork-choice rank of a block's slot claim (0 primary, 1
+        secondary, 2 none) from its claimed output — no pairing.
+        Evaluated against our CURRENT state; the strict check against
+        the true parent state runs inside _verify_and_apply.  A lying
+        rank needs the author's signature (the claim fields are under
+        it) and still dies post-rollback, transactionally."""
+        try:
+            out = bytes.fromhex(block.vrf_output)
+        except ValueError:
+            return consensus.RANK_NONE
+        if len(out) != 32:
+            return consensus.RANK_NONE
+        return consensus.claim_rank(
+            self.rt.rrsc, block.author, block.slot, out)
+
+    def _check_slot_claim(self, block: Block) -> bytes:
+        """Structural slot-claim verification against the parent state
+        (caller holds the lock, runtime is at the parent): decode the
+        claim, re-derive the output from the proof, enforce the
+        threshold/secondary rules.  Returns the VRF message whose
+        pairing the signature batch must cover."""
+        try:
+            out = bytes.fromhex(block.vrf_output)
+            proof = bytes.fromhex(block.vrf_proof)
+        except ValueError:
+            raise BlockImportError("undecodable VRF claim")
+        if len(out) != 32 or not proof:
+            raise BlockImportError("missing VRF claim")
+        try:
+            consensus.classify_claim(
+                self.rt.rrsc, block.author, block.slot, out, proof)
+        except ClaimError as e:
+            raise BlockImportError(str(e))
+        return consensus.slot_message(self.genesis, self.rt.rrsc,
+                                      block.slot)
+
+    def _import_block_inner(
+        self, block: Block, sigs_verified: bool = False
+    ) -> BlockRecord | None:
         with self._lock:
             try:
                 h = block.hash(self.genesis)
@@ -733,7 +811,11 @@ class NodeService:
                 head = self.block_store.get(self.head_hash)
                 if head is None or block.parent != head.parent:
                     return None  # unrelated fork; ignore
-                if (block.slot, h) >= (head.slot, self.head_hash):
+                rank = self._claim_rank(block)
+                head_rank = self._claim_rank(head)
+                if (rank, block.slot, h) >= (
+                    head_rank, head.slot, self.head_hash
+                ):
                     return None  # our head wins fork choice
                 # Authenticate BEFORE the destructive rollback: fork
                 # choice fields (number/slot/parent) are attacker-chosen,
@@ -742,7 +824,8 @@ class NodeService:
                 # runs below against the parent state; this gate pins the
                 # claimed author to the validator set and to a signature
                 # under that validator's key.
-                self._check_author_signature(block)
+                if not sigs_verified:
+                    self._check_author_signature(block)
                 undo = self._rollback_head()
                 head_n -= 1
             author_verified = undo is not None
@@ -755,14 +838,9 @@ class NodeService:
                     raise BlockImportError("unknown parent")
                 if block.slot <= self._parent_slot(block.parent):
                     raise BlockImportError("non-monotone slot")
-                expected = self._slot_author(block.slot)
-                if block.author != expected:
-                    raise BlockImportError(
-                        f"wrong author: slot {block.slot} belongs to "
-                        f"{expected}"
-                    )
                 record = self._verify_and_apply(
-                    block, author_verified=author_verified)
+                    block, author_verified=author_verified,
+                    sigs_verified=sigs_verified)
             except BlockImportError:
                 if undo is not None:
                     self._reinstate_head(*undo)
@@ -795,35 +873,47 @@ class NodeService:
             raise BlockImportError("bad author signature")
 
     def _verify_and_apply(
-        self, block: Block, author_verified: bool = False
+        self, block: Block, author_verified: bool = False,
+        sigs_verified: bool = False,
     ) -> tuple[BlockRecord, bytes]:
-        """Signature aggregate + deterministic re-execution; rolls the
-        runtime back on a post-state mismatch.  Caller holds the lock.
+        """Slot-claim check + signature batch + deterministic
+        re-execution; rolls the runtime back on a post-state mismatch.
+        Caller holds the lock, runtime is at the parent state.
         `author_verified=True` (the fork-choice path, where
         _check_author_signature already ran a full pairing) keeps the
-        block signature out of the aggregate instead of paying for it
-        twice."""
+        block signature out of the batch instead of paying for it
+        twice; `sigs_verified=True` (range-batch catch-up) skips every
+        pairing — the structural checks and re-execution still run."""
         pk = self._author_pk(block)
+        # VRF slot claim: structural rules against the parent state
+        # (output↔proof binding, threshold/secondary schedule); the
+        # proof's pairing joins the weighted batch below.
+        vrf_msg = self._check_slot_claim(block)
         try:
             exts = [Extrinsic.from_json(e) for e in block.extrinsics]
         except (KeyError, TypeError, ValueError) as e:
             raise BlockImportError(f"malformed extrinsic: {e!r}")
-        # One aggregate pairing check covers the author's block
-        # signature and every extrinsic signature (1 + #keys Miller
-        # loops instead of 2 per signature).  Sound because every
-        # payload is distinct — the block payload by shape, the
-        # extrinsic payloads by embedded (signer, nonce) — which the
-        # duplicate check enforces against a malicious author.
+        # ONE weighted batch pairing covers the author's block
+        # signature, the VRF slot proof, and every extrinsic signature
+        # (1 + #distinct-keys Miller-loop groups instead of 2 per
+        # signature).  The Fiat–Shamir weights (ops/bls_agg
+        # verify_batch_host) make the check per-signature sound — a
+        # plain aggregate is malleable, and the VRF OUTPUT is derived
+        # from the proof bytes, so proof malleability would hand the
+        # author a grindable randomness contribution.
         from ..ops import bls_agg
 
-        msgs: list[bytes] = []
-        pks: list[bytes] = []
-        raw_sigs: list[str] = []
-        seen_payloads = {block.signing_payload(self.genesis)}
-        if not author_verified:
-            msgs.append(block.signing_payload(self.genesis))
-            pks.append(pk)
-            raw_sigs.append(block.signature)
+        triples: list[tuple[bytes, bytes, bytes]] = []
+        seen_payloads = {block.signing_payload(self.genesis), vrf_msg}
+        try:
+            if not author_verified:
+                triples.append((
+                    pk, block.signing_payload(self.genesis),
+                    bytes.fromhex(block.signature),
+                ))
+            triples.append((pk, vrf_msg, bytes.fromhex(block.vrf_proof)))
+        except ValueError:
+            raise BlockImportError("undecodable signature")
         for ext in exts:
             epk = self.keys.get(ext.signer)
             if epk is None or not ext.signature:
@@ -832,20 +922,20 @@ class NodeService:
             if payload in seen_payloads:
                 raise BlockImportError("duplicate extrinsic payload")
             seen_payloads.add(payload)
-            pks.append(epk)
-            msgs.append(payload)
-            raw_sigs.append(ext.signature)
-        if raw_sigs:
             try:
-                agg = bls_agg.aggregate_signatures(
-                    [bytes.fromhex(s) for s in raw_sigs]
-                )
+                triples.append((epk, payload, bytes.fromhex(ext.signature)))
             except ValueError:
                 raise BlockImportError("undecodable signature")
-            if not bls_agg.verify_aggregate(pks, msgs, agg):
-                raise BlockImportError("bad block/extrinsic signature")
+        if not sigs_verified and not bls_agg.verify_batch_host(
+            triples, seed=self.genesis.encode()
+        ):
+            raise BlockImportError("bad block/extrinsic/vrf signature")
 
         pre_blob = self._state_blobs.get(self.head_hash)
+        # the verified output becomes consensus state before the block
+        # executes — mirror of produce_block's fold order
+        self.rt.rrsc.fold_vrf_output(
+            block.slot, bytes.fromhex(block.vrf_output))
         self.rt.run_blocks(1)
         record = BlockRecord(
             number=self.rt.state.block_number, author=block.author,

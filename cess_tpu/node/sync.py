@@ -57,8 +57,9 @@ def _rpc_errors() -> tuple[type, ...]:
     return (OSError, RpcError, ValueError, KeyError)
 
 # Bumped when the sync wire format changes; peers with a different
-# version are skipped during catch-up.
-SYNC_PROTO_VERSION = 1
+# version are skipped during catch-up.  v2: headers carry the BLS-VRF
+# slot claim (vrfOut/vrfProof — cess_tpu/consensus).
+SYNC_PROTO_VERSION = 2
 
 # Peer-gossip socket timeout: announcements are fire-and-forget, a dead
 # peer must not stall the authoring loop.
@@ -69,6 +70,15 @@ GOSSIP_TIMEOUT_S = 3.0
 # queue (full block JSON each) grows without bound.  Dropping is safe:
 # gossip is best-effort and catch-up recovers anything missed.
 GOSSIP_QUEUE_MAX = 64
+
+# Header-range batch verification during catch-up: above this gap the
+# node fetches a block range and checks EVERY signature in it — author
+# sigs, VRF slot proofs, extrinsic sigs — as one weighted pairing
+# product (ops/bls_agg) instead of one ~0.38 s pairing per block, then
+# imports with the per-block pairing skipped.  Below it the per-block
+# path wins (no batching overhead, and a bad block is pinned exactly).
+VERIFY_BATCH_MIN = 8
+SYNC_RANGE_MAX = 64
 
 
 # ------------------------------------------------------------ block wire
@@ -94,7 +104,11 @@ def extrinsic_root(extrinsics: list[dict]) -> str:
 class Block:
     """One announced block: header fields + full body.  `state_hash` is
     the POST-state hash (chain/checkpoint.py state_hash) — the import
-    check that pins replay determinism across replicas."""
+    check that pins replay determinism across replicas.  `vrf_output` /
+    `vrf_proof` are the author's BLS-VRF slot claim
+    (cess_tpu/consensus/vrf.py): the proof that the author won or owned
+    the slot, and the output that feeds the next epoch's randomness —
+    both under the author signature, so a relay cannot swap them."""
 
     number: int
     slot: int
@@ -103,13 +117,15 @@ class Block:
     state_hash: str      # post-execution state hash
     extrinsics: list[dict] = field(default_factory=list)
     signature: str = ""  # author's BLS signature over signing_payload()
+    vrf_output: str = ""  # hex 32-byte VRF output for (epoch, slot)
+    vrf_proof: str = ""   # hex 48-byte compressed G1 proof point
 
     def signing_payload(self, genesis: str) -> bytes:
         return canonical_json(
             [
                 genesis, "block", self.number, self.slot, self.parent,
                 self.author, extrinsic_root(self.extrinsics),
-                self.state_hash,
+                self.state_hash, self.vrf_output, self.vrf_proof,
             ]
         )
 
@@ -129,6 +145,7 @@ class Block:
             "parent": self.parent, "author": self.author,
             "stateHash": self.state_hash, "extrinsics": self.extrinsics,
             "sig": self.signature,
+            "vrfOut": self.vrf_output, "vrfProof": self.vrf_proof,
         }
 
     @classmethod
@@ -139,6 +156,8 @@ class Block:
             state_hash=str(d["stateHash"]),
             extrinsics=list(d.get("extrinsics", [])),
             signature=str(d.get("sig", "")),
+            vrf_output=str(d.get("vrfOut", "")),
+            vrf_proof=str(d.get("vrfProof", "")),
         )
 
 
@@ -281,12 +300,15 @@ class SyncManager:
         service,
         peers: list[tuple[str, int]],
         checkpoint_gap: int = 64,
+        batch_min: int = VERIFY_BATCH_MIN,
     ) -> None:
         from concurrent.futures import ThreadPoolExecutor
 
         self.service = service
         self.peers = list(peers)
         self.checkpoint_gap = checkpoint_gap
+        self.batch_min = max(2, batch_min)
+        self.batched_imports = 0  # blocks imported via range batches
         self._catchup_lock = threading.Lock()
         # One single-worker pool PER PEER: gossip to a given peer is
         # delivered in submission order (a same-signer extrinsic burst
@@ -408,6 +430,8 @@ class SyncManager:
         # again instead of crawling block by block.
         rewinds = 0
         allow_warp = True
+        allow_batch = True
+        batch_fetch_fails = 0
         while True:
             target = self._peer_status(host, port)
             if target is None:
@@ -433,6 +457,28 @@ class SyncManager:
                     s.m_catchup.inc()
                     continue
                 allow_warp = False  # unjustified/evicted anchor: replay
+            gap = target["number"] - s.head_number()
+            if allow_batch and gap >= self.batch_min and rewinds == 0:
+                got = self._batch_import(host, port, gap)
+                if got > 0:
+                    imported += got
+                    batch_fetch_fails = 0
+                    continue
+                if got == 0:
+                    # batch REFUSED (malformed range or a signature in
+                    # it failed): drop to the per-block path for the
+                    # rest of this run — it pins the exact failure
+                    # instead of re-fetching the refused range every
+                    # lap.  -1 = era-boundary cap, keep trying later
+                    # laps; -2 = transient fetch failure, retry a
+                    # couple of times before giving the batch up (one
+                    # dropped packet must not cost a whole epoch of
+                    # per-block pairings).
+                    allow_batch = False
+                elif got == -2:
+                    batch_fetch_fails += 1
+                    if batch_fetch_fails >= 2:
+                        allow_batch = False
             n = s.head_number() + 1
             try:
                 d = _rpc(host, port, "sync_block", [n], GOSSIP_TIMEOUT_S)
@@ -458,6 +504,103 @@ class SyncManager:
                     pass  # malformed justification: keep the block
             if rec is not None:  # None: a concurrent gossip import won
                 imported += 1
+        return imported
+
+    def _batch_import(self, host: str, port: int, gap: int) -> int:
+        """Range catch-up: fetch up to SYNC_RANGE_MAX consecutive blocks
+        and verify ALL their signatures — author header sigs, VRF slot
+        proofs, extrinsic sigs — in ONE weighted pairing product, then
+        import each block with the per-block pairing skipped (structural
+        claim checks and deterministic re-execution still run per
+        block).  Collapses an epoch of catch-up pairings to
+        1 + #distinct-signers.
+
+        The range is capped at the next era boundary (inclusive): VRF
+        messages are built from the CURRENT epoch context, which is
+        exactly valid for every block up to and including the boundary
+        block (rotation happens inside it, affecting only later
+        claims).  Returns blocks imported; 0 means "use the per-block
+        path" (range unavailable, malformed, or a signature failed —
+        the slow path pins which one).  -1 means the batch was not
+        applicable this lap (era-boundary cap left under two blocks) —
+        the caller may try again after the boundary imports.  -2 means
+        the range FETCH failed (transient peer stall / unsupported
+        method) — retryable, unlike a verification refusal."""
+        from ..consensus import engine
+        from ..ops import bls_agg as _agg
+        from .service import Extrinsic
+
+        s = self.service
+        start = s.head_number() + 1
+        count = min(gap, SYNC_RANGE_MAX)
+        era = getattr(s.rt.config, "era_duration_blocks", 0) or 0
+        if era > 0:
+            boundary = start + (-start) % era  # first multiple ≥ start
+            count = min(count, boundary - start + 1)
+        if count < 2:
+            return -1
+        try:
+            items = _rpc(host, port, "sync_block_range", [start, count],
+                         GOSSIP_TIMEOUT_S * 4)
+        except _rpc_errors():
+            return -2
+        if not isinstance(items, list) or len(items) < 2:
+            return 0
+        triples = []
+        blocks: list[tuple[Block, dict]] = []
+        try:
+            with s._lock:
+                if s.head_number() + 1 != start:
+                    # a concurrent gossip import advanced the head while
+                    # we fetched — the epoch context sampled below could
+                    # postdate an era boundary the range precedes, so an
+                    # honest range would fail verification.  Retryable.
+                    return -2
+                for want_n, d in enumerate(items, start):
+                    blk = Block.from_json(d["block"])
+                    if blk.number != want_n:
+                        return 0
+                    pk = s.keys.get(blk.author)
+                    if pk is None or not blk.signature:
+                        return 0
+                    msg = engine.slot_message(s.genesis, s.rt.rrsc,
+                                              blk.slot)
+                    triples.append(
+                        (pk, blk.signing_payload(s.genesis),
+                         bytes.fromhex(blk.signature)))
+                    triples.append(
+                        (pk, msg, bytes.fromhex(blk.vrf_proof)))
+                    for e in blk.extrinsics:
+                        ext = Extrinsic.from_json(e)
+                        epk = s.keys.get(ext.signer)
+                        if epk is None:
+                            return 0
+                        triples.append((
+                            epk, ext.payload(s.genesis),
+                            bytes.fromhex(ext.signature),
+                        ))
+                    blocks.append((blk, d))
+        except (KeyError, TypeError, ValueError):
+            return 0
+        if not _agg.verify_batch_host(triples, seed=s.genesis.encode()):
+            return 0
+        imported = 0
+        for blk, d in blocks:
+            try:
+                rec = s.import_block(blk, sigs_verified=True)
+            except (BlockImportError, SyncGap, KeyError, ValueError,
+                    TypeError, AttributeError):
+                break
+            if d.get("justification"):
+                try:
+                    s.handle_justification(
+                        Justification.from_json(d["justification"])
+                    )
+                except (KeyError, TypeError, ValueError):
+                    pass
+            if rec is not None:
+                imported += 1
+                self.batched_imports += 1
         return imported
 
     def _pull_finality(self, host: str, port: int, status: dict) -> None:

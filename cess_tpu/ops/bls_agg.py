@@ -82,13 +82,15 @@ def _hash_points(msgs: list[bytes]) -> list[G1Point]:
     return [memo[m] for m in msgs]
 
 
-def batch_verify_signatures(
-    triples: list[SigTriple], seed: bytes = b"", mesh=None
+def _weighted_batch_check(
+    triples: list[SigTriple], seed: bytes, mesh, device: bool
 ) -> bool:
-    """One combined pairing check for the whole batch.  False if ANY
-    signature is invalid (or any pk/sig fails to parse).  mesh: optional
-    jax.sharding.Mesh — shards the signature-side fold over its devices
-    (parallel/msm.py), bit-identical to the single-device path."""
+    """THE weighted batch equation, shared by the device and host entry
+    points: parse, Fiat–Shamir weights, per-key grouping and the pairs
+    assembly are single-sourced on purpose — this check IS a consensus
+    rule (block import on one node, catch-up batches on another must
+    accept identical batches), so the two backends may only differ in
+    HOW the two G1 folds are computed, never in what is folded."""
     if not triples:
         return True
     try:
@@ -98,15 +100,7 @@ def batch_verify_signatures(
         return False
     rhos = batch_weights(agg_transcript(seed, triples), len(triples))
 
-    # signature-side fold: one flat MSM over the whole batch
-    if mesh is not None:
-        from ..parallel.msm import msm_sharded
-
-        lhs = msm_sharded(mesh, sig_pts, rhos, bits=_RHO_BITS)
-    else:
-        lhs = g1.msm(sig_pts, rhos, bits=_RHO_BITS)
-
-    # message-side folds, grouped by distinct public key
+    # message-side grouping by distinct public key
     h_pts = _hash_points([msg for _, msg, _ in triples])
     groups: dict[bytes, tuple[list[G1Point], list[int]]] = {}
     for (pk, _, _), h, r in zip(triples, h_pts, rhos):
@@ -114,14 +108,44 @@ def batch_verify_signatures(
         pts.append(h)
         rs.append(r)
     keys = list(groups)
-    folds = g1.msm_grouped(
-        [groups[k][0] for k in keys],
-        [groups[k][1] for k in keys],
-        bits=_RHO_BITS,
-    )
+
+    if device:
+        # signature-side fold: one flat MSM over the whole batch
+        if mesh is not None:
+            from ..parallel.msm import msm_sharded
+
+            lhs = msm_sharded(mesh, sig_pts, rhos, bits=_RHO_BITS)
+        else:
+            lhs = g1.msm(sig_pts, rhos, bits=_RHO_BITS)
+        folds = g1.msm_grouped(
+            [groups[k][0] for k in keys],
+            [groups[k][1] for k in keys],
+            bits=_RHO_BITS,
+        )
+    else:
+        lhs = G1Point.infinity()
+        for sig, r in zip(sig_pts, rhos):
+            lhs = lhs + sig._mul_raw(r)
+        folds = []
+        for k in keys:
+            acc = G1Point.infinity()
+            for h, r in zip(*groups[k]):
+                acc = acc + h._mul_raw(r)
+            folds.append(acc)
+
     pairs = [(lhs, -bls.G2_GENERATOR)]
     pairs.extend((fold, pk_pts[k]) for k, fold in zip(keys, folds))
     return bls.pairing_check(pairs)
+
+
+def batch_verify_signatures(
+    triples: list[SigTriple], seed: bytes = b"", mesh=None
+) -> bool:
+    """One combined pairing check for the whole batch.  False if ANY
+    signature is invalid (or any pk/sig fails to parse).  mesh: optional
+    jax.sharding.Mesh — shards the signature-side fold over its devices
+    (parallel/msm.py), bit-identical to the single-device path."""
+    return _weighted_batch_check(triples, seed, mesh, device=True)
 
 
 def verify_signatures(
@@ -139,6 +163,27 @@ def verify_signatures(
     return verify_signatures(triples[:mid], seed, mesh) + verify_signatures(
         triples[mid:], seed, mesh
     )
+
+
+def verify_batch_host(triples: list[SigTriple], seed: bytes = b"") -> bool:
+    """The same Fiat–Shamir small-exponent batch equation as
+    `batch_verify_signatures` (one shared implementation,
+    `_weighted_batch_check`), with the two G1 folds computed HOST-side
+    (pure-Python ladders) instead of on device.
+
+    This is the live block-import path (node/service.py): a node's hot
+    loop must not pay a JAX trace/compile, and import batches are tiny
+    (one block signature + one VRF proof + a handful of extrinsics), so
+    a few 128-bit host scalar muls (~2 ms each) beat any device
+    round-trip.  Soundness is the point, not speed: unlike
+    `verify_aggregate`, the per-triple weights r_i (bound to the full
+    transcript, signatures included) make the check hold iff EVERY
+    signature individually verifies — a plain aggregate is malleable
+    (sig_a+Δ, sig_b−Δ passes), and consensus derives the VRF output
+    from the proof BYTES, so malleability there would let an author
+    grind epoch randomness.  Verdict is bit-identical to the device
+    path by construction."""
+    return _weighted_batch_check(triples, seed, mesh=None, device=False)
 
 
 # ------------------------------------------------------- plain aggregation

@@ -21,7 +21,13 @@ One storage-network epoch's device workload — "1M segments RS-recover +
   stage BLS     the epoch's TEE verdict signatures checked as ONE
                 weighted batch (ops/bls_agg.py) with the signature-side
                 fold sharded over the mesh (reference per-signature
-                loop: utils/verify-bls-signatures/src/lib.rs:85-100).
+                loop: utils/verify-bls-signatures/src/lib.rs:85-100);
+
+  stage VRF     the epoch's header slot claims (cess_tpu/consensus:
+                BLS-VRF proofs over (epoch randomness, slot)) verified
+                as one batched pairing product — the catch-up /
+                header-audit shape: an entire epoch of headers costs
+                1 + #authors pairings instead of 2 per block.
 
 Every stage is checked against host arithmetic when `check=True` (the
 default — tests run tiny geometries on the virtual 8-device CPU mesh);
@@ -57,11 +63,14 @@ class EpochReport:
     sigma_ok: bool
     signatures: int
     bls_ok: bool
+    headers: int = 0
+    vrf_ok: bool = True
     seconds: dict[str, float] = field(default_factory=dict)
 
     @property
     def ok(self) -> bool:
-        return self.rs_ok and self.combine_ok and self.sigma_ok and self.bls_ok
+        return (self.rs_ok and self.combine_ok and self.sigma_ok
+                and self.bls_ok and self.vrf_ok)
 
 
 # ------------------------------------------------------------ RS stage
@@ -103,6 +112,8 @@ def run_epoch(
     n_sectors: int = 3,
     n_signatures: int = 8,
     n_keys: int = 2,
+    n_headers: int = 64,
+    n_validators: int = 3,
     seed: int = 7,
     check: bool = True,
 ) -> EpochReport:
@@ -117,7 +128,7 @@ def run_epoch(
         return -(-n // n_dev) * n_dev
 
     n_segments, n_proofs = r(n_segments), r(n_proofs)
-    n_signatures = r(n_signatures)
+    n_signatures, n_headers = r(n_signatures), r(n_headers)
 
     # ---------------- stage RS: recover every segment from (data1, parity)
     code = rs.RSCode(2, 1)
@@ -195,6 +206,26 @@ def run_epoch(
     )
     seconds["bls_aggregate"] = time.perf_counter() - t0
 
+    # ------------- stage VRF: the epoch's header slot claims, one batch
+    from ..consensus import vrf as _vrf
+
+    vkeys = [bls.keygen(b"epoch-author-%d" % k) for k in range(n_validators)]
+    vpks = [bls.sk_to_pk(sk) for sk in vkeys]
+    epoch_rand = b"%032d" % seed
+    claims = []
+    for slot in range(n_headers):
+        k = slot % n_validators
+        msg = _vrf.vrf_input("epoch-sim", 1, epoch_rand, slot)
+        out, proof = _vrf.prove(vkeys[k], msg)
+        claims.append((vpks[k], msg, out, proof))
+    t0 = time.perf_counter()
+    vrf_ok = _vrf.batch_verify(claims, b"epoch-%d" % seed, mesh=mesh)
+    seconds["vrf_headers"] = time.perf_counter() - t0
+    if check:
+        vrf_ok = vrf_ok and all(
+            _vrf.verify(*claims[i]) for i in (0, n_headers - 1)
+        )
+
     return EpochReport(
         n_devices=n_dev,
         segments=n_segments,
@@ -205,5 +236,7 @@ def run_epoch(
         sigma_ok=sigma_ok,
         signatures=n_signatures,
         bls_ok=bls_ok,
+        headers=n_headers,
+        vrf_ok=vrf_ok,
         seconds=seconds,
     )

@@ -66,8 +66,17 @@ class XlaBackend(ProofBackend):
         mesh=None,
         device_h2c: bool | None = None,
         fused: bool | None = None,
+        profile_stages: bool = False,
     ) -> None:
         self.mesh = mesh
+        # profile_stages: accumulate a per-stage wall-clock breakdown of
+        # _combined_check into `stage_seconds` (host prep / u fold /
+        # σ fold / chunk program / pairing).  Each boundary blocks on
+        # the stage's device values so the split is real — run it on a
+        # SEPARATE pass, never the timed one (bench.py does exactly
+        # that; the blocking serializes the dispatch pipeline).
+        self.profile_stages = profile_stages
+        self.stage_seconds: dict[str, float] = {}
         # fused: None = auto (the single-program GLV pipeline of
         # proof/fused.py on a real TPU); True/False force it — tests
         # force True to exercise the fused path on the CPU mesh.
@@ -199,6 +208,25 @@ class XlaBackend(ProofBackend):
             return False
         if any(not 0 <= m < R for _, _, p in items for m in p.mu):
             return False
+        import time as _time
+
+        stages = self.stage_seconds if self.profile_stages else None
+
+        def mark(name, t0):
+            """Stage boundary: charge the elapsed wall clock to `name`.
+            Honest because every stage below ends in host
+            materialization (g1.msm / limbs_to_ints return host
+            values, pairing_check is host) — a stage changed to return
+            a device-resident array must add its own block_until_ready
+            here or its cost silently migrates to the next bucket.
+            No-op when not profiling."""
+            if stages is None:
+                return t0
+            now = _time.perf_counter()
+            stages[name] = stages.get(name, 0.0) + (now - t0)
+            return now
+
+        t0 = _time.perf_counter() if stages is not None else 0.0
         batch_items = [podr2.BatchItem(n, c, p) for n, c, p in items]
         rhos = podr2.batch_rho(
             podr2.batch_transcript(seed, batch_items), len(items)
@@ -210,6 +238,7 @@ class XlaBackend(ProofBackend):
         mu_limbs = np.stack(
             [fr.fr_to_limbs(p.mu) for _, _, p in items]
         )  # (B, S, 37)
+        t0 = mark("host_prep", t0)
         if self.mesh is not None:
             from ..parallel import combine_mu_sharded
 
@@ -225,9 +254,11 @@ class XlaBackend(ProofBackend):
             )
         else:
             exps = fr.limbs_to_ints(fr.combine_mu(rhos, mu_limbs))
+        t0 = mark("u_fold", t0)
 
         # σ-side: Π σ_b^{ρ_b} — one flat MSM over the batch.
         lhs = g1.msm(sigmas, rhos, bits=_RHO_BITS)
+        t0 = mark("sigma_fold", t0)
 
         # H-side: per-item Π_c H^{v_c} (grouped MSM over the challenged
         # chunk points), then the ρ fold across items.  At batch scale
@@ -265,14 +296,18 @@ class XlaBackend(ProofBackend):
             ]
             inner = g1.msm_grouped(h_pts, h_coeffs, bits=_COEFF_BITS)
         rhs = g1.msm(inner, rhos, bits=_RHO_BITS)
+        t0 = mark("chunk_program", t0)
 
         # u-side: Π_j u_j^{e_j} over the global sector generators.
         us = list(podr2.u_generators(params.s))
         rhs = rhs + g1.msm(us, exps)
+        t0 = mark("u_fold", t0)
 
-        return bls.pairing_check(
+        verdict = bls.pairing_check(
             [(lhs, -bls.G2_GENERATOR), (rhs, pk_point)]
         )
+        mark("pairing", t0)
+        return verdict
 
     def verify_batch(
         self,

@@ -39,6 +39,11 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: protocol-geometry tests (minutes of compiles)"
     )
+    config.addinivalue_line(
+        "markers",
+        "consensus: fast VRF/slot-claim unit tests — CI runs these as "
+        "their own gate even when the slow testnet e2e is skipped",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

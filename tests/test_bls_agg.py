@@ -71,6 +71,45 @@ class TestBatchVerify:
         assert len(set(w)) == 3 and all(x & 1 for x in w)
 
 
+class TestHostBatch:
+    """verify_batch_host: the live-import path (host G1 folds, no JAX)
+    — same weighted equation as the device batch, plus the property
+    the node layer depends on: per-signature soundness under
+    aggregate-preserving malleation."""
+
+    def test_matches_device_batch_verdicts(self):
+        good = _make_batch(5, 2)
+        assert bls_agg.verify_batch_host(good, b"seed")
+        bad = _make_batch(5, 2)
+        bad[2] = (bad[2][0], bad[2][1], bad[3][2])
+        assert not bls_agg.verify_batch_host(bad, b"seed")
+        assert not bls_agg.verify_batch_host(
+            [(b"\x00" * 96, b"m", b"\x00" * 48)], b"seed")
+        assert bls_agg.verify_batch_host([], b"seed")
+
+    def test_aggregate_malleation_rejected(self):
+        """Shift one signature by Δ and another by −Δ: the SUM is
+        unchanged, so the plain aggregate check still passes — but the
+        weighted batch must refuse, because consensus derives the VRF
+        output from the proof bytes and a malleable check would make
+        that output grindable (cess_tpu/consensus/vrf.py)."""
+        from cess_tpu.ops.bls12_381 import G1Point
+
+        triples = _make_batch(2, 1, tag=b"mall")
+        (pk, m0, s0), (_, m1, s1) = triples
+        delta = bls.G1_GENERATOR.mul(12345)
+        shifted = [
+            (pk, m0, (G1Point.from_bytes(s0) + delta).to_bytes()),
+            (pk, m1, (G1Point.from_bytes(s1) + (-delta)).to_bytes()),
+        ]
+        agg = bls_agg.aggregate_signatures([s for _, _, s in shifted])
+        # the plain aggregate cannot see the malleation…
+        assert bls_agg.verify_aggregate([pk, pk], [m0, m1], agg)
+        # …the weighted batch (both paths) must
+        assert not bls_agg.verify_batch_host(shifted, b"seed")
+        assert not bls_agg.batch_verify_signatures(shifted, b"seed")
+
+
 class TestAggregate:
     def test_aggregate_roundtrip(self):
         triples = _make_batch(5, 2)

@@ -53,17 +53,22 @@ class TestEpochSim:
             n_sectors=3,
             n_signatures=8,
             n_keys=2,
+            n_headers=8,
+            n_validators=2,
             seed=11,
         )
         assert report.rs_ok, "RS recovery diverged from the original data"
         assert report.combine_ok, "audit combine diverged from host"
         assert report.sigma_ok, "sharded sigma fold diverged from host"
         assert report.bls_ok, "aggregate BLS verification failed"
+        assert report.vrf_ok, "VRF header batch verification failed"
         assert report.ok
         assert report.n_devices == 8
         assert report.segments == 16 and report.proofs == 16
+        assert report.headers == 8
         assert set(report.seconds) == {
             "rs", "audit_combine", "sigma_fold", "bls_aggregate",
+            "vrf_headers",
         }
 
     def test_batch_sizes_round_up_to_mesh(self, mesh):
@@ -76,9 +81,12 @@ class TestEpochSim:
             n_sectors=2,
             n_signatures=3,
             n_keys=1,
+            n_headers=5,
+            n_validators=1,
             seed=4,
         )
         assert report.ok
         assert report.segments == 16  # rounded to a mesh multiple
         assert report.proofs == 8
         assert report.signatures == 8
+        assert report.headers == 8  # rounded to a mesh multiple

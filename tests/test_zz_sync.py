@@ -22,6 +22,7 @@ from cess_tpu.node import (
     SyncManager,
     local_spec,
 )
+from cess_tpu.consensus import engine, vrf
 from cess_tpu.node.chain_spec import ChainSpec, dev_sk
 from cess_tpu.node.metrics import scoped_registry
 from cess_tpu.node.sync import quorum, verify_justification
@@ -44,10 +45,52 @@ def make_node(spec, authority) -> NodeService:
 
 
 def slot_owned_by(svc: NodeService, name: str, start: int) -> int:
+    """First slot from `start` whose SECONDARY author is `name` — a
+    slot the validator can always claim."""
     slot = start
     while svc._slot_author(slot) != name:
         slot += 1
     return slot
+
+
+def claim_of(svc: NodeService, name: str, slot: int):
+    return engine.claim_slot(
+        svc.rt.rrsc, svc.genesis, name,
+        dev_sk(name, svc.spec.chain_id), slot,
+    )
+
+
+def secondary_only_slot(svc: NodeService, name: str, start: int) -> int:
+    """A slot where `name`'s claim is secondary (not primary) — used by
+    fork-choice tests that reason about claim ranks."""
+    slot = start
+    while True:
+        slot = slot_owned_by(svc, name, slot)
+        c = claim_of(svc, name, slot)
+        if c is not None and not c.primary:
+            return slot
+        slot += 1
+
+
+def unclaimable_slot(svc: NodeService, name: str, start: int,
+                     secondary: str | None = None) -> int:
+    """A slot where `name` has NO claim (above threshold and not the
+    secondary author); optionally pin who the secondary must be."""
+    slot = start
+    while True:
+        owner = svc._slot_author(slot)
+        if owner != name and (secondary is None or owner == secondary):
+            if claim_of(svc, name, slot) is None:
+                return slot
+        slot += 1
+
+
+def vrf_fields(svc: NodeService, name: str, slot: int) -> dict:
+    """Genuine (vrf_output, vrf_proof) hex pair under `name`'s key for
+    a slot, regardless of whether the claim would win."""
+    msg = engine.slot_message(svc.genesis, svc.rt.rrsc, slot)
+    out, proof = vrf.prove(dev_sk(name, svc.spec.chain_id), msg)
+    return {"vrf_output": out.hex(), "vrf_proof": proof.hex()}
 
 
 class Lockstep:
@@ -108,21 +151,32 @@ class TestImportVerification:
         spec = make_spec()
         a = make_node(spec, "alice")
         b = make_node(spec, "bob")
-        # bob authors a block at a slot the schedule gives to alice
-        slot = slot_owned_by(a, "alice", 1)
+        # bob authors a block at a slot where his VRF gives him NO
+        # claim (above threshold, secondary is alice) — a genuine VRF
+        # evaluation under his own key does not help
+        slot = unclaimable_slot(a, "bob", 1, secondary="alice")
         forged = Block(
             number=1, slot=slot, parent=b.genesis, author="bob",
-            state_hash="00" * 32,
+            state_hash="00" * 32, **vrf_fields(a, "bob", slot),
         ).sign(dev_sk("bob", spec.chain_id), b.genesis)
         with pytest.raises(BlockImportError, match="wrong author"):
             a.import_block(forged)
-        # right author name, wrong key underneath
+        # right author name, wrong key underneath (signature and VRF
+        # proof both from bob's key under alice's name)
+        slot_a = slot_owned_by(a, "alice", 1)
         forged2 = Block(
-            number=1, slot=slot, parent=b.genesis, author="alice",
-            state_hash="00" * 32,
+            number=1, slot=slot_a, parent=b.genesis, author="alice",
+            state_hash="00" * 32, **vrf_fields(a, "bob", slot_a),
         ).sign(dev_sk("bob", spec.chain_id), b.genesis)
-        with pytest.raises(BlockImportError, match="signature"):
+        with pytest.raises(BlockImportError, match="signature|proof"):
             a.import_block(forged2)
+        # no VRF claim at all
+        forged3 = Block(
+            number=1, slot=slot_a, parent=b.genesis, author="alice",
+            state_hash="00" * 32,
+        ).sign(dev_sk("alice", spec.chain_id), b.genesis)
+        with pytest.raises(BlockImportError, match="VRF"):
+            a.import_block(forged3)
         assert a.rt.state.block_number == 0  # nothing applied
 
     def test_state_hash_mismatch_rolls_back(self):
@@ -168,38 +222,47 @@ class TestImportVerification:
         assert "miner-0" in b.rt.sminer.miner_items
 
     def test_forged_fork_block_cannot_displace_head(self):
-        """Fork-choice fields (number/slot/parent) are attacker-chosen:
-        an unauthenticated announce that would win fork choice must not
-        knock the genuine head off (the rollback is transactional)."""
+        """Fork-choice fields (number/slot/claim rank) are
+        attacker-chosen: an announce that would win fork choice must
+        not knock the genuine head off (the rollback is
+        transactional)."""
         spec = make_spec()
         a = make_node(spec, "alice")
         b = make_node(spec, "bob")
-        sa = slot_owned_by(a, "alice", 10)
+        sa = secondary_only_slot(a, "alice", 10)
         rec = a.produce_block(slot=sa)
         blk = a.block_store[rec.hash]
         b.import_block(blk)
         head_before = b.head_hash
         state_before = b.state_hash()
-        # same height, same parent, lower slot → would win fork choice;
-        # signed by a non-validator key
+        # same height, same parent, lower slot, fabricated all-zero
+        # "primary" output that would win fork choice — but signed by a
+        # non-validator key: authentication runs BEFORE the destructive
+        # rollback, so the genuine head never moves
         forged = Block(
             number=1, slot=1, parent=blk.parent, author="alice",
             state_hash=blk.state_hash, extrinsics=[],
+            vrf_output="00" * 32, vrf_proof="11" * 48,
         ).sign(dev_sk("mallory", spec.chain_id), b.genesis)
         with pytest.raises(BlockImportError):
             b.import_block(forged)
         assert b.head_hash == head_before
         assert b.state_hash() == state_before
         assert b.rt.state.block_number == 1
-        # a validator-signed fork block that fails the slot-author check
-        # post-rollback reinstates the old head too
-        s2 = slot_owned_by(a, "alice", 1)
+        # a VALIDATOR-signed fork block claiming a fabricated primary
+        # win (all-zero output beats any threshold, rank 0 beats the
+        # head's secondary rank 1) enters the fork path, rolls the head
+        # back — and the claim check (output does not re-derive from
+        # the proof) reinstates it transactionally
+        s2 = slot_owned_by(b, "bob", 1)
         if s2 < sa:
+            fake = vrf_fields(b, "bob", s2)
             forged2 = Block(
                 number=1, slot=s2, parent=blk.parent, author="bob",
                 state_hash=blk.state_hash, extrinsics=[],
+                vrf_output="00" * 32, vrf_proof=fake["vrf_proof"],
             ).sign(dev_sk("bob", spec.chain_id), b.genesis)
-            with pytest.raises(BlockImportError):
+            with pytest.raises(BlockImportError, match="proof|author"):
                 b.import_block(forged2)
             assert b.head_hash == head_before
             assert b.state_hash() == state_before
@@ -271,8 +334,9 @@ class TestImportVerification:
         spec = make_spec()
         a = make_node(spec, "alice")
         b = make_node(spec, "bob")
-        sa = slot_owned_by(a, "alice", 1)
-        sb = slot_owned_by(b, "bob", sa + 1)
+        # both claims secondary: equal rank, so the earlier slot wins
+        sa = secondary_only_slot(a, "alice", 1)
+        sb = secondary_only_slot(b, "bob", sa + 1)
         rec_a = a.produce_block(slot=sa)
         rec_b = b.produce_block(slot=sb)
         block_a = a.block_store[rec_a.hash]
@@ -386,9 +450,10 @@ class TestFinality:
             b.import_block(blk)
             c.import_block(blk)
         # two competing empty blocks at height 4 (the finality
-        # boundary): charlie's at a lower slot wins fork choice
-        s_c = slot_owned_by(c, "charlie", slot + 1)
-        s_a = slot_owned_by(a, "alice", s_c + 1)
+        # boundary), both secondary claims: charlie's at a lower slot
+        # wins fork choice
+        s_c = secondary_only_slot(c, "charlie", slot + 1)
+        s_a = secondary_only_slot(a, "alice", s_c + 1)
         rec_a = a.produce_block(slot=s_a)
         blk_a = a.block_store[rec_a.hash]
         rec_c = c.produce_block(slot=s_c)

@@ -204,6 +204,36 @@ class TestThreeProcessTestnet:
                 len(j["signers"]) * 3 >= 2 * 3 for j in justs
             )
 
+            # ---- VRF-proven authorship: the finalized header carries
+            # the slot claim every replica verified at import.  The
+            # accumulated randomness is consensus state, so its
+            # per-height bit-identity across replicas is ALREADY pinned
+            # by the matching state hashes above (checkpoint covers the
+            # rrsc accumulator); the live epochInfo view must agree on
+            # the epoch-level values (accumulator/foldCount race with
+            # the 500 ms head between free-running nodes, so only
+            # rotation-stable fields can be compared point-in-time).
+            assert all(
+                b["block"]["vrfOut"] and b["block"]["vrfProof"]
+                for b in blocks
+            )
+
+            def epoch_info_converged():
+                infos = [
+                    rpc_call(HOST, p, "rrsc_epochInfo", [], timeout=5.0)
+                    for p in ports
+                ]
+                same = len({
+                    (i["epochIndex"], i["randomness"]) for i in infos
+                }) == 1
+                accumulating = all(i["foldCount"] >= 1 for i in infos)
+                return infos[0] if same and accumulating else False
+
+            wait_for(
+                epoch_info_converged, 90,
+                "identical epoch randomness on every replica",
+            )
+
             # ---- kill charlie; the remaining 2/3 keep finalizing
             procs["charlie"].send_signal(signal.SIGKILL)
             procs["charlie"].wait(timeout=30)
