@@ -286,9 +286,15 @@ class AuditPallet:
             "InvalidUnsigned",
             "duplicate vote",
         )
+        # Stale-proposal purge, loose on purpose: under a lossy network
+        # (the chaos soak, node/faults.py) validators' votes for one
+        # trigger block arrive staggered across several blocks, and a
+        # purge bound of `count` wiped forming tallies faster than
+        # quorum could meet — the round then never commits.  4× keeps
+        # state bounded while letting a staggered quorum land.
         if h not in self.challenge_proposal and len(
             self.challenge_proposal
-        ) > count:
+        ) > 4 * count:
             self.challenge_proposal.clear()
             self.proposal_voters.clear()
         self.proposal_voters.setdefault(h, set()).add(key)

@@ -51,6 +51,8 @@ _PALLETS = (
     "cacher",
     "scheduler_credit",
     "staking",
+    "session",
+    "offences",
     "tee_worker",
     "file_bank",
     "audit",
@@ -63,7 +65,12 @@ _NESTED_TYPES = {"Balances", "Agenda"}
 
 # Injected-callable slots: wiring, never state — excluded even when unset
 # (None), so the hash does not depend on whether a verifier is plugged in.
-_WIRING_FIELDS = {"result_verifier", "cert_verifier"}
+# `_observers` (session) and `evidence_verifier` (offences) are runtime
+# wiring re-created by construction; session observer callbacks and the
+# node-layer evidence closure must never travel in a blob.
+_WIRING_FIELDS = {
+    "result_verifier", "cert_verifier", "_observers", "evidence_verifier",
+}
 
 # Offchain-local storage: per-node worker state (the reference keeps it
 # in the offchain DB, not the state trie).  Each validator's OCW lock
@@ -298,6 +305,10 @@ def _dataclass_registry() -> dict[str, type]:
 # v3: VRF consensus state on the rrsc pallet (epoch-randomness
 #     accumulator + fold count, cess_tpu/consensus) — epoch randomness
 #     became accumulated consensus state instead of a derived snapshot.
+# v4: session + offences pallets entered the replicated state
+#     (chain/{session,offences}.py — session clock, historical
+#     authority sets, heartbeat record, offence registry/strikes, and
+#     staking's chill register).
 #
 # MIGRATIONS[v] upgrades a decoded v payload dict to v+1; restore runs
 # the chain v → FORMAT_VERSION, so any supported older blob loads into
@@ -306,7 +317,7 @@ def _dataclass_registry() -> dict[str, type]:
 # entry here instead of breaking old fixtures.
 
 MAGIC = b"CESSCKPT"
-FORMAT_VERSION = 3
+FORMAT_VERSION = 4
 
 
 def _migrate_v1_to_v2(data: dict) -> dict:
@@ -326,7 +337,31 @@ def _migrate_v2_to_v3(data: dict) -> dict:
     return data
 
 
-MIGRATIONS = {1: _migrate_v1_to_v2, 2: _migrate_v2_to_v3}
+def _migrate_v3_to_v4(data: dict) -> dict:
+    """Pre-offences blobs carry no session/offences pallets: seed both
+    EXPLICITLY empty (not merely absent) so a migrated blob restores to
+    the same state on every replica regardless of what the receiving
+    runtime held before — a fresh session clock, no heartbeats, no
+    offences, no chills.  (session_length/sessions_per_era stay as the
+    receiving runtime's genesis config derived them — consensus
+    parameters, not snapshot state.)"""
+    if "session" not in data:
+        data["session"] = {
+            "session_index": 0, "keys": {}, "historical": {},
+            "historical_validators": {},
+        }
+    if "offences" not in data:
+        data["offences"] = {
+            "reports": {}, "pending": [], "heartbeats": {}, "strikes": {},
+        }
+    staking = data.get("staking")
+    if isinstance(staking, dict):
+        staking.setdefault("chilled_until", {})
+    return data
+
+
+MIGRATIONS = {1: _migrate_v1_to_v2, 2: _migrate_v2_to_v3,
+              3: _migrate_v3_to_v4}
 
 
 # ---------------------------------------------------------------- API

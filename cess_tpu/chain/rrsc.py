@@ -77,6 +77,14 @@ class RrscPallet:
         # controller → stash through its SchedulerStashAccountFinder,
         # the runtime/src/impls.rs:30-40 role).
         credits = self.scheduler_credit.credits(self.epoch_index)
+        # chilled candidacies (offences) are skipped inside elect; an
+        # election that would seat nobody keeps the previous set —
+        # both surfaced in the NewEpoch event so liveness drills can
+        # read the rotation's health off the event stream
+        chilled = sum(
+            1 for c in self.staking.candidates
+            if self.staking.is_chilled(c)
+        )
         elected = self.staking.elect(
             self.max_validators,
             credits,
@@ -97,7 +105,8 @@ class RrscPallet:
         self.vrf_accumulator = self.epoch_randomness
         self.vrf_fold_count = 0
         self.state.deposit_event(
-            MOD, "NewEpoch", index=self.epoch_index, validators=len(elected)
+            MOD, "NewEpoch", index=self.epoch_index,
+            validators=len(elected), chilled_skipped=chilled,
         )
         return elected
 

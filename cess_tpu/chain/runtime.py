@@ -25,14 +25,40 @@ from .rrsc import RrscPallet
 from .cacher import CacherPallet
 from .evm import EvmPallet
 from .file_bank import FileBankPallet
+from .offences import OffencesPallet
 from .oss import OssPallet
 from .scheduler_credit import SchedulerCreditPallet
+from .session import SessionPallet
 from .sminer import SminerPallet
 from .staking import StakingPallet
 from .state import ChainState, ScheduledCall
 from .storage_handler import StorageHandlerPallet
 from .tee_worker import TeeWorkerPallet
 from .types import BLOCKS_PER_DAY, BLOCKS_PER_HOUR, Balance, DispatchError, TOKEN
+
+
+def session_plan(era_duration_blocks: int, sessions_per_era: int = 0,
+                 ) -> tuple[int, int]:
+    """(session_length, sessions_per_era) for an era duration: the two
+    must multiply back to era_duration_blocks exactly so the session
+    clock and the legacy era clock agree on every boundary.  An
+    explicit sessions_per_era that divides the era cleanly wins;
+    otherwise pick the most sessions ≤ 6 that keep sessions at least 4
+    blocks long (heartbeats need a couple of blocks to land before the
+    end-of-session sweep reads them)."""
+    era = max(1, era_duration_blocks)
+    if sessions_per_era > 0:
+        if era % sessions_per_era != 0:
+            raise ValueError(
+                f"sessions_per_era={sessions_per_era} does not divide "
+                f"era_duration_blocks={era} — session and era clocks "
+                "would disagree on boundaries"
+            )
+        return era // sessions_per_era, sessions_per_era
+    for k in range(6, 1, -1):
+        if era % k == 0 and era // k >= 4:
+            return era // k, k
+    return era, 1
 
 
 @dataclass
@@ -46,6 +72,10 @@ class RuntimeConfig:
     space_unit_price: Balance = 30 * TOKEN      # per GiB-month
     era_duration_blocks: int = 6 * BLOCKS_PER_HOUR
     eras_per_year: int = 1460
+    # Sessions per era (pallet_session; SessionsPerEra=6 in the
+    # reference, runtime/src/lib.rs:245).  0 = derive from the era
+    # duration (see session_plan); an explicit value must divide it.
+    sessions_per_era: int = 0
     credit_period_blocks: int = BLOCKS_PER_DAY
     audit_lock_time: int = 10                   # LockTime (runtime lib.rs:994)
     podr2_chunk_count: int = 1024               # CHUNK_COUNT (common lib.rs:62)
@@ -56,6 +86,12 @@ class RuntimeConfig:
     # so rrsc.slot_author rotates over them from the first slot.
     genesis_validators: list = field(default_factory=list)
     genesis_validator_stake: Balance = 10_000 * TOKEN
+    # Genesis validator CANDIDACIES: bonded (topped up to the genesis
+    # stake if needed) and registered via staking.validate, so the
+    # credit-weighted election actually rotates the set at era
+    # boundaries.  Distinct from genesis_validators: candidates are
+    # not seated until an election elects them.
+    genesis_candidates: list = field(default_factory=list)
     # Pinned attestation trust anchors (proof/ias.RootStore).  None skips
     # the attestation gate (unit-test pallets in isolation); the node sim
     # always pins a root (reference pins Intel's at
@@ -117,6 +153,25 @@ class Runtime:
         self.rrsc = RrscPallet(self.state, self.staking, self.scheduler_credit)
         self.evm = EvmPallet(self.state)
 
+        # Offences + sessions (im-online/offences/session role,
+        # runtime/src/lib.rs:1484-1527): the session clock drives era
+        # rotation; the offences pallet sweeps heartbeats at every
+        # session end (observer) and applies convictions at era
+        # boundaries, just before the election.
+        self.offences = OffencesPallet(
+            self.state, self.staking, self.scheduler_credit
+        )
+        s_len, s_per_era = session_plan(
+            cfg.era_duration_blocks, cfg.sessions_per_era
+        )
+        self.session = SessionPallet(
+            self.state, self.staking, self.rrsc,
+            session_length=s_len, sessions_per_era=s_per_era,
+            offences=self.offences,
+        )
+        self.offences.session = self.session
+        self.session.add_observer(self.offences.session_sweep)
+
         for acc, amount in cfg.endowed.items():
             self.state.balances.mint(acc, amount)
 
@@ -131,6 +186,20 @@ class Runtime:
                 self.state.balances.mint(v, stake - free)
             self.staking.bond(v, v, stake)
             self.staking.add_validator(v)
+        # Genesis candidacies: bonded + validate()d so the era-boundary
+        # election has a real candidate pool from block 1.
+        for c in cfg.genesis_candidates:
+            if c not in self.staking.bonded:
+                stake = cfg.genesis_validator_stake
+                free = self.state.balances.free(c)
+                if free < stake:
+                    self.state.balances.mint(c, stake - free)
+                self.staking.bond(c, c, stake)
+            self.staking.validate(c)
+        # Session 0's authority set enters the historical record so
+        # offence evidence against a genesis authority verifies before
+        # the first rotation.
+        self.session.record_genesis_set()
         # Genesis authorities are also the audit quorum keys (the
         # session-keys genesis role) so a live chain's offchain workers
         # can vote challenges from block 1 without a harness call.
@@ -169,13 +238,14 @@ class Runtime:
         for call in self.state.agenda.take_due(now):
             self._dispatch_scheduled(call)
 
-        # Era rotation (session/staking stand-in) + RRSC epoch rotation
-        # (credit-weighted election runs only when candidacies exist, so
-        # genesis-seeded authority sets stay put in minimal sims).
-        if now % self.config.era_duration_blocks == 0:
-            self.staking.end_era()
-            if self.staking.candidates:
-                self.rrsc.rotate_epoch()
+        # Session rotation → offence application → era rotation → RRSC
+        # epoch rotation (the session clock ticks sessions_per_era times
+        # per era, so the era boundary lands on exactly the same blocks
+        # as the pre-session `now % era_duration_blocks == 0` rule; the
+        # credit-weighted election still runs only when candidacies
+        # exist, so genesis-seeded authority sets stay put in minimal
+        # sims).
+        self.session.on_initialize(now)
 
     def _dispatch_scheduled(self, call: ScheduledCall) -> None:
         fn = self._dispatch.get((call.pallet, call.method))

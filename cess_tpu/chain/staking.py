@@ -44,6 +44,12 @@ BONDING_DURATION_ERAS = 28
 # stock HistoryDepth role): unclaimed payouts expire, state stays bounded.
 HISTORY_DEPTH_ERAS = 84
 
+# Election weight cap per candidate, as a multiple of MinValidatorBond
+# (the MaxExposure role): one whale's backing cannot dominate the
+# credit-weighted score beyond this.  Election-only — payouts still
+# distribute over the REAL backing.
+MAX_BACKING_BONDS = 256
+
 
 @dataclass
 class UnlockChunk:
@@ -75,11 +81,15 @@ class StakingPallet:
         self.sminer = sminer
         self.eras_per_year = eras_per_year
         self.min_validator_bond = min_validator_bond
+        self.max_candidate_backing = MAX_BACKING_BONDS * min_validator_bond
         self.bonded: dict[AccountId, AccountId] = {}  # stash -> controller
         self.ledger: dict[AccountId, Ledger] = {}  # stash -> ledger
         self.validators: list[AccountId] = []  # ACTIVE set (stash accounts)
         self.candidates: list[AccountId] = []  # validator candidacies
         self.nominations: dict[AccountId, list[AccountId]] = {}
+        # stash → first era it may validate again (offences chill; the
+        # election and `validate` both skip stashes still inside it)
+        self.chilled_until: dict[AccountId, int] = {}
         self.active_era: int = 0
         self.eras_validator_reward: dict[int, Balance] = {}
         self.era_backing: dict[int, dict[AccountId, dict[AccountId, Balance]]] = {}
@@ -149,12 +159,15 @@ class StakingPallet:
     # -- intentions -------------------------------------------------------
 
     def validate(self, stash: AccountId) -> None:
-        """Declare validator candidacy (stock `validate`)."""
+        """Declare validator candidacy (stock `validate`).  A stash
+        still inside an offences chill must sit the chill out before
+        re-declaring."""
         ledger = self.ledger.get(stash)
         ensure(ledger is not None, MOD, "NotStash")
         ensure(
             ledger.bonded >= self.min_validator_bond, MOD, "InsufficientBond"
         )
+        ensure(not self.is_chilled(stash), MOD, "Chilled")
         if stash not in self.candidates:
             self.candidates.append(stash)
 
@@ -170,6 +183,21 @@ class StakingPallet:
         if stash in self.candidates:
             self.candidates.remove(stash)
         self.nominations.pop(stash, None)
+
+    def is_chilled(self, stash: AccountId) -> bool:
+        return self.active_era < self.chilled_until.get(stash, 0)
+
+    def force_chill(self, stash: AccountId, until_era: int) -> None:
+        """Offences-driven chill: drop the candidacy AND refuse
+        re-candidacy until `until_era` (the DisableStrategy role —
+        chill() alone lets the offender `validate` right back in)."""
+        self.chill(stash)
+        self.chilled_until[stash] = max(
+            self.chilled_until.get(stash, 0), until_era
+        )
+        self.state.deposit_event(
+            MOD, "Chilled", stash=stash, until_era=until_era
+        )
 
     def add_validator(self, stash: AccountId) -> None:
         """Directly seat a validator (genesis/authority injection).  Does
@@ -194,6 +222,31 @@ class StakingPallet:
                     out[nom] = out.get(nom, 0) + nl.bonded // len(targets)
         return out
 
+    def _all_backings(self) -> dict[AccountId, dict[AccountId, Balance]]:
+        """who-backs-whom for EVERY candidate in one pass: O(candidates
+        + nominations) instead of backing_of's O(candidates ×
+        nominations) — the part of the election that must stay cheap at
+        thousands of candidates."""
+        out: dict[AccountId, dict[AccountId, Balance]] = {}
+        for stash in self.candidates:
+            backing: dict[AccountId, Balance] = {}
+            ledger = self.ledger.get(stash)
+            if ledger is not None and ledger.bonded:
+                backing[stash] = ledger.bonded
+            out[stash] = backing
+        for nom, targets in self.nominations.items():
+            nl = self.ledger.get(nom)
+            if nl is None or not nl.bonded:
+                continue
+            share = nl.bonded // len(targets)
+            if not share:
+                continue
+            for target in targets:
+                backing = out.get(target)
+                if backing is not None:
+                    backing[nom] = backing.get(nom, 0) + share
+        return out
+
     def elect(
         self, max_validators: int, credits: dict[AccountId, int] | None = None,
         full_credit: int = 1000,
@@ -202,23 +255,55 @@ class StakingPallet:
         role (reference: the forked consensus consumes
         scheduler-credit's ValidatorCredits impl,
         c-pallets/scheduler-credit/src/lib.rs:242-251): each candidate's
-        total backing is scaled by (full + credit)/full, so TEE service
-        reputation tilts the election.  Deterministic: ties break on the
-        account id."""
+        total backing — CAPPED at max_candidate_backing so one whale
+        cannot own the set — is scaled by (full + credit)/full, so TEE
+        service reputation tilts the election.  Deterministic: ties
+        break on the account id.
+
+        Bags-shaped (the bags-list role of the reference's election
+        provider): candidates are bucketed into exponential score bags
+        (bag b holds scores in [2^(b-1), 2^b), so every member of a
+        higher bag outranks every member of a lower one) and only the
+        bags actually needed to fill the set are sorted — placement is
+        O(candidates), sorting is bounded by the consumed bags, and the
+        result is bit-identical to a full global sort.  Chilled stashes
+        (offences) are skipped outright."""
         credits = credits or {}
-        scored = []
-        backings: dict[AccountId, dict[AccountId, Balance]] = {}
+        backings = self._all_backings()
+        bags: dict[int, list[tuple[int, AccountId]]] = {}
         for stash in self.candidates:
+            if self.is_chilled(stash):
+                continue
             ledger = self.ledger.get(stash)
             if ledger is None or ledger.bonded < self.min_validator_bond:
                 continue
-            backings[stash] = self.backing_of(stash)
-            weight = full_credit + credits.get(stash, 0)
-            scored.append(
-                (sum(backings[stash].values()) * weight // full_credit, stash)
+            backing = min(
+                sum(backings[stash].values()), self.max_candidate_backing
             )
-        scored.sort(key=lambda t: (-t[0], t[1]))
-        elected = [s for _, s in scored[:max_validators]]
+            weight = full_credit + credits.get(stash, 0)
+            score = backing * weight // full_credit
+            bags.setdefault(score.bit_length(), []).append((score, stash))
+        elected: list[AccountId] = []
+        for bag in sorted(bags, reverse=True):
+            if len(elected) >= max_validators:
+                break
+            for score, stash in sorted(
+                bags[bag], key=lambda t: (-t[0], t[1])
+            ):
+                elected.append(stash)
+                if len(elected) >= max_validators:
+                    break
+        if not elected:
+            # Never seat an empty authority set: a chain whose every
+            # candidate is chilled or under-bonded keeps its previous
+            # validators (liveness over rotation).  They still earn:
+            # record their live backing for this era so payout_stakers
+            # can distribute the era pool to the set that actually
+            # validated it.
+            self.era_backing[self.active_era] = {
+                s: self.backing_of(s) for s in self.validators
+            }
+            return list(self.validators)
         self.validators = elected
         self.era_backing[self.active_era] = {s: backings[s] for s in elected}
         return elected
@@ -306,3 +391,21 @@ class StakingPallet:
         self.state.balances.unreserve(stash, taken)
         self.state.balances.transfer(stash, TREASURY_POT, taken)
         self.state.deposit_event(MOD, "Slashed", staker=stash, amount=taken)
+
+    def slash_offender(self, stash: AccountId, percent: int) -> Balance:
+        """Offence slash: `percent`% of the offender's CURRENT bonded
+        stake moves from its reserve straight to the treasury pot (the
+        offences → staking slashing route, reference:
+        slashing.rs + runtime/src/lib.rs:1509).  Unlocking chunks are
+        not chased (scope-cut register, docs/offences.md).  Returns
+        the amount actually taken."""
+        ledger = self.ledger.get(stash)
+        if ledger is None:
+            return 0
+        amount = ledger.bonded * max(0, min(100, percent)) // 100
+        taken = self.state.balances.slash_reserved(
+            stash, TREASURY_POT, amount
+        )
+        ledger.bonded -= min(ledger.bonded, taken)
+        self.state.deposit_event(MOD, "Slashed", staker=stash, amount=taken)
+        return taken

@@ -84,6 +84,19 @@ class Balances:
         a.free += moved
         return moved
 
+    def slash_reserved(
+        self, who: AccountId, dst: AccountId, amount: Balance
+    ) -> Balance:
+        """Take up to `amount` of who's RESERVED balance and credit it to
+        `dst` (the Currency::slash_reserved + OnUnbalanced-to-treasury
+        route offence slashing uses).  Saturates like unreserve; returns
+        what was actually taken."""
+        a = self.account(who)
+        taken = min(a.reserved, amount)
+        a.reserved -= taken
+        self.account(dst).free += taken
+        return taken
+
 
 @dataclass
 class ScheduledCall:

@@ -18,7 +18,10 @@ RPC — while the data-plane heavy lifting stays on the TPU backends
 
 from .chain_spec import ChainSpec, dev_spec, local_spec
 from .client import MinerClient, RpcClient, TeeClient, UserClient
-from .metrics import REGISTRY, Counter, Gauge, Histogram, Registry
+from .faults import ChaosProfile, FaultInjector
+from .metrics import (
+    REGISTRY, Counter, Gauge, Histogram, LabeledCounter, Registry,
+)
 from .rpc import RpcServer
 from .service import Extrinsic, NodeService, TxPool
 from .sync import (
@@ -32,8 +35,10 @@ from .sync import (
 
 __all__ = [
     "ChainSpec", "dev_spec", "local_spec",
+    "ChaosProfile", "FaultInjector",
     "RpcClient", "MinerClient", "TeeClient", "UserClient",
-    "REGISTRY", "Counter", "Gauge", "Histogram", "Registry",
+    "REGISTRY", "Counter", "Gauge", "Histogram", "LabeledCounter",
+    "Registry",
     "RpcServer", "Extrinsic", "NodeService", "TxPool",
     "Block", "BlockImportError", "Justification", "SyncGap",
     "SyncManager", "Vote",

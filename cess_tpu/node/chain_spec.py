@@ -25,7 +25,8 @@ from ..ops import bls12_381 as bls
 _GENESIS_KNOBS = (
     "one_day_block", "one_hour_block", "frozen_days", "space_unit_price",
     "era_duration_blocks", "eras_per_year", "credit_period_blocks",
-    "audit_lock_time", "podr2_chunk_count",
+    "audit_lock_time", "podr2_chunk_count", "sessions_per_era",
+    "genesis_candidates",
 )
 
 

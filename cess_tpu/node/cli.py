@@ -42,20 +42,32 @@ def _cmd_run(args) -> int:
     if args.finality_period is not None:
         spec.finality_period = args.finality_period
     service = NodeService(spec, authority=args.authority)
+    service.chaos_mute = bool(args.chaos_mute)
     if args.import_state:
         with open(args.import_state, "rb") as fh:
             service.import_state(fh.read())
+    faults = None
+    if args.chaos_seed is not None:
+        from .faults import FaultInjector
+
+        faults = FaultInjector(args.chaos_seed, args.chaos_profile)
     if args.peers:
         SyncManager(
             service, _parse_peers(args.peers),
             checkpoint_gap=args.checkpoint_gap,
+            faults=faults,
         )
     server = RpcServer(service, host=args.rpc_host, port=args.rpc_port)
     server.start()
+    chaos = (
+        f" chaos={args.chaos_profile}/{args.chaos_seed}"
+        if args.chaos_seed is not None else ""
+    )
     print(
         f"cess-tpu-node: chain={spec.chain_id} rpc={server.host}:{server.port}"
         f" block_time={spec.block_time_ms}ms"
-        f" peers={len(service.sync.peers) if service.sync else 0}",
+        f" peers={len(service.sync.peers) if service.sync else 0}"
+        f"{chaos}{' MUTED' if args.chaos_mute else ''}",
         flush=True,
     )
     service.start()
@@ -165,6 +177,17 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--checkpoint-gap", type=int, default=64,
                      help="catch-up gap above which a node bootstraps "
                           "from a peer checkpoint instead of replaying")
+    run.add_argument("--chaos-seed", type=int, default=None,
+                     help="enable deterministic fault injection on this "
+                          "node's outbound gossip + catch-up RPC "
+                          "(node/faults.py); same seed, same schedule")
+    run.add_argument("--chaos-profile", default="mild",
+                     choices=["off", "light", "mild", "hostile"],
+                     help="fault-probability profile for --chaos-seed")
+    run.add_argument("--chaos-mute", action="store_true",
+                     help="skip im-online heartbeats (a deliberately "
+                          "silent validator for liveness drills — it "
+                          "gets chilled by the offences sweep)")
     run.set_defaults(fn=_cmd_run)
 
     bs = sub.add_parser("build-spec", help="print a chain spec")

@@ -37,6 +37,44 @@ class Counter(_Metric):
         return [(self.name, "", self.value)]
 
 
+class LabeledCounter(_Metric):
+    """Counter with one label dimension (the prometheus labelled-series
+    shape, e.g. per-peer gossip drops): each distinct label value is
+    its own monotone series, rendered as `name{label="value"} n`."""
+
+    kind = "counter"
+
+    def __init__(self, name, help_="", label="peer", registry=None):
+        super().__init__(name, help_, registry)
+        self.label = label
+        self.values: dict[str, float] = {}
+
+    def inc(self, label_value: str, amount: float = 1.0) -> None:
+        with self._lock:
+            self.values[label_value] = (
+                self.values.get(label_value, 0.0) + amount
+            )
+
+    def get(self, label_value: str) -> float:
+        with self._lock:
+            return self.values.get(label_value, 0.0)
+
+    def total(self) -> float:
+        with self._lock:
+            return sum(self.values.values())
+
+    def counts(self) -> dict[str, float]:
+        with self._lock:
+            return dict(self.values)
+
+    def samples(self):
+        with self._lock:
+            return [
+                (self.name, f'{self.label}="{v}"', n)
+                for v, n in sorted(self.values.items())
+            ]
+
+
 class Gauge(_Metric):
     kind = "gauge"
 

@@ -78,6 +78,12 @@ class RpcApi:
                 "isSyncing": False,
                 "shouldHavePeers": len(s.spec.validators) > 1,
                 "txpool": len(s.pool),
+                # per-peer gossip overflow drops (node/sync.py): a
+                # partitioned or hung peer shows up here instead of
+                # dropping silently
+                "gossipDropped": (
+                    s.sync.drop_counts() if s.sync is not None else {}
+                ),
             }
 
         @method("system_metrics")
@@ -379,6 +385,36 @@ class RpcApi:
                     ),
                 }
 
+        @method("sync_offence")
+        def _sync_offence(report: dict):
+            """Offence-report gossip intake (chain/offences.py): the
+            service independently re-verifies the evidence before
+            relaying or submitting anything — a forged report from a
+            malicious peer is a no-op."""
+            try:
+                return s.handle_offence_report(report)
+            except (KeyError, TypeError, ValueError) as e:
+                raise RpcError(-32023, f"malformed offence report: {e!r}")
+
+        @method("offences_state")
+        def _offences_state():
+            """Offence registry view: convictions, strikes, chills, and
+            the live heartbeat record — what liveness drills assert."""
+            off = s.rt.offences
+            return {
+                "reports": [
+                    _view(rec) for _, rec in sorted(off.reports.items())
+                ],
+                "pending": len(off.pending),
+                "strikes": _view(off.strikes),
+                "chilledUntil": _view(s.rt.staking.chilled_until),
+                "heartbeats": {
+                    str(sess): sorted(who)
+                    for sess, who in off.heartbeats.items()
+                },
+                "sessionIndex": s.rt.session.session_index,
+            }
+
         @method("sync_vote")
         def _sync_vote(vote: dict):
             try:
@@ -408,6 +444,21 @@ class RpcApi:
             return {
                 "challenge": s.rt.audit.challenge_duration,
                 "verify": s.rt.audit.verify_duration,
+            }
+
+        @method("audit_challengeProposals")
+        def _chal_proposals():
+            """Open challenge-vote tallies (the quorum forming): one
+            entry per proposal hash with its vote count and voters —
+            how liveness drills see a stalled or split quorum."""
+            return {
+                h.hex()[:16]: {
+                    "votes": votes,
+                    "voters": sorted(
+                        s.rt.audit.proposal_voters.get(h, set())),
+                }
+                for h, (votes, _info)
+                in s.rt.audit.challenge_proposal.items()
             }
 
         # ---- dev helpers
@@ -512,6 +563,12 @@ def rpc_call(host: str, port: int, method: str, params: list | None = None,
             if not chunk:
                 break
             buf += chunk
+    if not buf:
+        # The server accepted the connection but never answered (its
+        # handler starved behind the service lock, or it shut down
+        # mid-request).  Surface a TRANSIENT socket-shaped error, not a
+        # JSONDecodeError — callers treat OSError as retryable.
+        raise ConnectionError("connection closed before response")
     resp = json.loads(buf)
     if "error" in resp:
         raise RpcError(resp["error"]["code"], resp["error"]["message"])

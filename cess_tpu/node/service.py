@@ -33,6 +33,7 @@ from dataclasses import dataclass, field
 from ..chain.runtime import Runtime
 from ..chain.types import DispatchError
 from ..chain import checkpoint
+from ..chain import offences as offences_mod
 from ..consensus import ClaimError, engine as consensus
 from ..ops import bls12_381 as bls
 from .chain_spec import ChainSpec, dev_sk
@@ -255,6 +256,12 @@ EXTRINSIC_DISPATCH: dict = {
         "submit_proof", "submit_verify_result",
     )},
     ("audit", "save_challenge_info"): _adapt_save_challenge,
+    # im-online heartbeat + offence evidence intake (reference:
+    # im-online/offences pallets at runtime/src/lib.rs:1509).  Both
+    # dispatch generically: heartbeat(sender, session_index) and
+    # report_offence(sender, report_json) — the report re-verifies its
+    # own evidence inside the pallet, so any account may carry it.
+    **{("offences", c): None for c in ("heartbeat", "report_offence")},
     # pallet_evm call/create/deposit/withdraw role (reference:
     # runtime/src/lib.rs:1322-1344)
     **{("evm", c): None for c in ("deposit", "withdraw")},
@@ -380,6 +387,15 @@ class NodeService:
         self.genesis = hashlib.blake2b(
             spec.to_json().encode(), digest_size=32
         ).hexdigest()
+        # Evidence wiring (chain/offences.py): the pallet re-verifies
+        # every offence report against THIS chain's genesis and key
+        # registry before anything is queued — an unverifiable report
+        # is a deterministic failed receipt on every replica.
+        self.rt.offences.evidence_verifier = (
+            lambda rep: offences_mod.verify_report(
+                rep, self.genesis, self.keys.get
+            )
+        )
         self.pool = TxPool()
         self.nonces: dict[str, int] = {}
         self.blocks: list[BlockRecord] = []
@@ -429,6 +445,21 @@ class NodeService:
         self._pending_justs: dict[int, Justification] = {}
         self.sync = None  # node/sync.py SyncManager, via attach_sync()
 
+        # Offences bookkeeping (node side): sessions this node already
+        # heartbeat for, offence report keys already submitted/gossiped
+        # (gossip floods re-deliver each report N-1 times), and the
+        # chaos knob that mutes the heartbeat OCW (--chaos-mute — a
+        # deliberately lazy validator for liveness drills).
+        self._hb_sent: set[int] = set()
+        self._offences_seen: set[tuple] = set()
+        self.chaos_mute = False
+        # Self-healing candidacy: True once this node has observed its
+        # own authority in staking.candidates — only then will the OCW
+        # re-submit `validate` after an offences chill lapses (an
+        # authority that never declared must not be volunteered).
+        self._was_candidate = False
+        self._revalidate_era = -1
+
         # Per-service registry by default: two services in one process
         # must not collide on metric names in the global REGISTRY.
         reg = registry if registry is not None else m.Registry()
@@ -459,6 +490,11 @@ class NodeService:
         self.m_vrf_secondary = m.Counter(
             "cess_vrf_secondary_claims", "secondary slot claims authored",
             reg)
+        self.m_heartbeats = m.Counter(
+            "cess_heartbeats_sent", "im-online heartbeats submitted", reg)
+        self.m_offences = m.Counter(
+            "cess_offences_reported",
+            "offence reports this node built or relayed", reg)
         self.registry = reg
 
     # ------------------------------------------------------ submission
@@ -811,6 +847,34 @@ class NodeService:
                 head = self.block_store.get(self.head_hash)
                 if head is None or block.parent != head.parent:
                     return None  # unrelated fork; ignore
+                author_checked = sigs_verified
+                if (block.author == head.author
+                        and block.slot == head.slot
+                        and (offences_mod.KIND_BLOCK_EQUIV, block.author,
+                             self.rt.session.session_of_block(head.number))
+                        not in self._offences_seen):
+                    # Two headers for ONE slot by ONE author: block
+                    # equivocation.  Authenticate the competing header
+                    # first — an unverified conflict must never accuse
+                    # an honest author — then route the signed pair as
+                    # a portable offence report regardless of which
+                    # fork wins below (the loser is still evidence).
+                    # Our head's signature was verified at its import;
+                    # sigs_verified=True (range-batch catch-up) means
+                    # the batch already verified the competing one.
+                    # The _offences_seen pre-check keeps re-delivered
+                    # losing conflicts (gossip repeats every announce
+                    # N-1 times) from paying the ~0.4 s pairing below
+                    # on every replay.
+                    if not author_checked:
+                        try:
+                            self._check_author_signature(block)
+                            author_checked = True
+                        except BlockImportError:
+                            pass  # forged conflict: no report
+                    if author_checked:
+                        self._submit_offence_report(
+                            self._block_offence_report(head, block))
                 rank = self._claim_rank(block)
                 head_rank = self._claim_rank(head)
                 if (rank, block.slot, h) >= (
@@ -823,8 +887,9 @@ class NodeService:
                 # genuine head off.  The full slot-author check still
                 # runs below against the parent state; this gate pins the
                 # claimed author to the validator set and to a signature
-                # under that validator's key.
-                if not sigs_verified:
+                # under that validator's key.  (Skipped when the block-
+                # equivocation probe above already paid this pairing.)
+                if not author_checked:
                     self._check_author_signature(block)
                 undo = self._rollback_head()
                 head_n -= 1
@@ -1093,6 +1158,7 @@ class NodeService:
         ):
             return False
         just = None
+        offence = None
         with self._lock:
             if vote.number <= self.finalized_number:
                 return False
@@ -1104,25 +1170,39 @@ class NodeService:
                 # prior one at tally time, this one just above; an
                 # unverified conflicting vote must never evict an
                 # honest validator's weight).  Purge the voter from
-                # every tally at this height and refuse further votes.
+                # every tally at this height and refuse further votes
+                # — and turn the signature pair into a PORTABLE
+                # offence report (chain/offences.py): two signatures
+                # over conflicting finality payloads that any replica
+                # can re-verify, so one honest observer convicts the
+                # equivocator on every node (submitted below, outside
+                # the lock).
+                prior_sig = self._votes.get(
+                    (vote.number, prior), {}).get(vote.voter)
                 self._equivocators.setdefault(
                     vote.number, set()).add(vote.voter)
                 for (n, _h), tally in self._votes.items():
                     if n == vote.number:
                         tally.pop(vote.voter, None)
                 self._vote_hash[vote.number].pop(vote.voter, None)
-                return False
-            tally = self._votes.setdefault(
-                (vote.number, vote.block_hash), {})
-            if vote.voter in tally:
-                return True
-            tally[vote.voter] = vote.signature
-            self._vote_hash.setdefault(
-                vote.number, {})[vote.voter] = vote.block_hash
-            self.m_votes.inc()
-            if quorum(len(tally), len(validators)):
-                just = Justification.from_votes(
-                    vote.number, vote.block_hash, tally)
+                if prior_sig is not None:
+                    offence = self._vote_offence_report(
+                        vote, prior, prior_sig)
+            else:
+                tally = self._votes.setdefault(
+                    (vote.number, vote.block_hash), {})
+                if vote.voter in tally:
+                    return True
+                tally[vote.voter] = vote.signature
+                self._vote_hash.setdefault(
+                    vote.number, {})[vote.voter] = vote.block_hash
+                self.m_votes.inc()
+                if quorum(len(tally), len(validators)):
+                    just = Justification.from_votes(
+                        vote.number, vote.block_hash, tally)
+        if offence is not None:
+            self._submit_offence_report(offence)
+            return False
         if just is not None and self.handle_justification(
             just, _verified=True  # aggregated from individually
         ):                        # verified votes one line up
@@ -1189,6 +1269,100 @@ class NodeService:
             }
         return True
 
+    # ------------------------------------------------------ offences
+
+    def _vote_offence_report(
+        self, vote: Vote, prior_hash: str, prior_sig: str
+    ) -> "offences_mod.OffenceReport":
+        """Package a proven double-vote as portable evidence: the two
+        finality payloads (node/sync.py canonical bytes) plus the
+        offender's two verified signatures."""
+        session = self.rt.session.session_of_block(vote.number)
+        return offences_mod.OffenceReport(
+            kind=offences_mod.KIND_VOTE_EQUIV, offender=vote.voter,
+            session=session,
+            evidence=[
+                [finality_payload(
+                    self.genesis, vote.number, prior_hash).hex(),
+                 prior_sig],
+                [finality_payload(
+                    self.genesis, vote.number, vote.block_hash).hex(),
+                 vote.signature],
+            ],
+        )
+
+    def _block_offence_report(
+        self, ours: Block, theirs: Block
+    ) -> "offences_mod.OffenceReport":
+        """Two verified headers for ONE slot by ONE author — the block
+        flavor of equivocation evidence (both signing payloads carry
+        the author and slot, so any replica re-verifies the conflict
+        from the report alone)."""
+        session = self.rt.session.session_of_block(ours.number)
+        return offences_mod.OffenceReport(
+            kind=offences_mod.KIND_BLOCK_EQUIV, offender=ours.author,
+            session=session,
+            evidence=[
+                [ours.signing_payload(self.genesis).hex(),
+                 ours.signature],
+                [theirs.signing_payload(self.genesis).hex(),
+                 theirs.signature],
+            ],
+        )
+
+    def _submit_offence_report(self, report) -> None:
+        """Route a locally proven (or peer-gossiped and re-verified)
+        offence report: submit it as a signed extrinsic through our own
+        pool when this node is a validator, and gossip the raw report so
+        keyless observers' detections still reach someone who can.  Both
+        paths dedup on the report key — gossip floods re-deliver every
+        report N-1 times."""
+        key = report.key()
+        if key in self._offences_seen:
+            return
+        self._offences_seen.add(key)
+        self.m_offences.inc()
+        ident = self._ocw_identity
+        can_sign = (
+            ident is not None and self.authority_sk is not None
+            and not (self.authority is None and self.sync is not None)
+        )
+        if can_sign:
+            with self._lock:
+                if not self.rt.offences.known(key):
+                    ext = Extrinsic(
+                        signer=ident, module="offences",
+                        call="report_offence", args=[report.to_json()],
+                        nonce=self.nonces.get(ident, 0),
+                    )
+                    ext.sign(self.authority_sk, self.genesis)
+                    try:
+                        # our own signature from a line up: skip the
+                        # intake pairing (the evidence itself is
+                        # re-verified at dispatch on every replica)
+                        self.submit_extrinsic(ext, _verified=True)
+                    except ValueError:
+                        pass
+        if self.sync is not None:
+            self.sync.broadcast_offence(report)
+
+    def handle_offence_report(self, report_json: dict) -> str:
+        """`sync_offence` intake: independently re-verify a gossiped
+        report before relaying or submitting it — a forged report from
+        a malicious peer dies here and is never signed into our pool."""
+        try:
+            report = offences_mod.OffenceReport.from_json(report_json)
+        except (KeyError, TypeError, ValueError):
+            return "malformed"
+        if report.key() in self._offences_seen:
+            return "known"
+        if not offences_mod.verify_report(
+            report, self.genesis, self.keys.get
+        ):
+            return "invalid"
+        self._submit_offence_report(report)
+        return "ok"
+
     # ------------------------------------------------------ offchain
 
     def _post_block(self, now: int) -> None:
@@ -1211,6 +1385,62 @@ class NodeService:
             # dev-derived validators[0] identity (same guard as
             # produce_block / _finality_tick)
             return
+        # im-online heartbeat (reference: im-online lib.rs:342-359): a
+        # networked authority signs ONE heartbeat per session through
+        # its own pool — the same path as audit votes — so the
+        # end-of-session sweep (chain/offences.py) can tell live
+        # validators from silent ones.  Single-node / header-less
+        # runtimes never heartbeat, and the sweep's zero-heartbeat
+        # guard keeps them unchilled.  `chaos_mute` (--chaos-mute)
+        # deliberately silences this node for liveness drills.
+        if self.sync is not None and not self.chaos_mute:
+            with self._lock:
+                sess = self.rt.session.session_index
+                if (sess not in self._hb_sent
+                        and ident in self.rt.staking.validators):
+                    self._hb_sent.add(sess)
+                    self._hb_sent = {
+                        s for s in self._hb_sent if s + 4 > sess
+                    }
+                    hb = Extrinsic(
+                        signer=ident, module="offences", call="heartbeat",
+                        args=[sess], nonce=self.nonces.get(ident, 0),
+                    )
+                    hb.sign(self.authority_sk, self.genesis)
+                    try:
+                        # self-signed a line up: skip the intake pairing
+                        self.submit_extrinsic(hb, _verified=True)
+                        self.m_heartbeats.inc()
+                    except ValueError:
+                        pass
+        if self.sync is not None:
+            # Self-healing candidacy: an offences chill suspends this
+            # node's validator intent (staking.force_chill removes the
+            # candidacy); once the chill lapses, a LIVE node re-declares
+            # through its own pool — a spuriously chilled honest
+            # validator rejoins the election, a dead one stays out.
+            with self._lock:
+                staking = self.rt.staking
+                if ident in staking.candidates:
+                    self._was_candidate = True
+                elif (
+                    self._was_candidate
+                    and not staking.is_chilled(ident)
+                    and ident in staking.ledger
+                    and staking.ledger[ident].bonded
+                    >= staking.min_validator_bond
+                    and self._revalidate_era != staking.active_era
+                ):
+                    self._revalidate_era = staking.active_era
+                    rv = Extrinsic(
+                        signer=ident, module="staking", call="validate",
+                        args=[], nonce=self.nonces.get(ident, 0),
+                    )
+                    rv.sign(self.authority_sk, self.genesis)
+                    try:
+                        self.submit_extrinsic(rv, _verified=True)
+                    except ValueError:
+                        pass
         with self._lock:
             if ident not in self.rt.audit.keys:
                 return

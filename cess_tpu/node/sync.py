@@ -36,6 +36,7 @@ from __future__ import annotations
 import hashlib
 import json
 import threading
+import time
 from dataclasses import dataclass, field
 
 from ..ops import bls12_381 as bls
@@ -56,10 +57,13 @@ def _rpc_errors() -> tuple[type, ...]:
 
     return (OSError, RpcError, ValueError, KeyError)
 
-# Bumped when the sync wire format changes; peers with a different
-# version are skipped during catch-up.  v2: headers carry the BLS-VRF
-# slot claim (vrfOut/vrfProof — cess_tpu/consensus).
-SYNC_PROTO_VERSION = 2
+# Bumped when the sync wire format OR the deterministic state machine
+# changes; peers with a different version are skipped during catch-up.
+# v2: headers carry the BLS-VRF slot claim (vrfOut/vrfProof —
+# cess_tpu/consensus).  v3: session/offences pallets joined the
+# replicated state (chain/{session,offences}.py) — a v2 peer would
+# re-execute our blocks to a different state hash.
+SYNC_PROTO_VERSION = 3
 
 # Peer-gossip socket timeout: announcements are fire-and-forget, a dead
 # peer must not stall the authoring loop.
@@ -70,6 +74,15 @@ GOSSIP_TIMEOUT_S = 3.0
 # queue (full block JSON each) grows without bound.  Dropping is safe:
 # gossip is best-effort and catch-up recovers anything missed.
 GOSSIP_QUEUE_MAX = 64
+
+# Catch-up RPC retry policy: transient socket failures (refused, timed
+# out, chaos-injected) are retried with bounded exponential backoff and
+# DETERMINISTIC jitter before the peer is given up for this lap.
+# Definitive replies (RpcError, malformed JSON) never retry, and gossip
+# casts keep their one-timeout guarantee — only the catch-up pull path
+# retries, where one dropped packet otherwise costs a whole lap.
+CATCHUP_RPC_ATTEMPTS = 3
+CATCHUP_BACKOFF_BASE_S = 0.05
 
 # Header-range batch verification during catch-up: above this gap the
 # node fetches a block range and checks EVERY signature in it — author
@@ -301,15 +314,35 @@ class SyncManager:
         peers: list[tuple[str, int]],
         checkpoint_gap: int = 64,
         batch_min: int = VERIFY_BATCH_MIN,
+        faults=None,
     ) -> None:
         from concurrent.futures import ThreadPoolExecutor
+
+        from . import metrics as m
 
         self.service = service
         self.peers = list(peers)
         self.checkpoint_gap = checkpoint_gap
         self.batch_min = max(2, batch_min)
         self.batched_imports = 0  # blocks imported via range batches
+        # node/faults.py FaultInjector (chaos harness): shapes this
+        # node's OUTBOUND gossip and catch-up RPC; None = clean network.
+        self.faults = faults
         self._catchup_lock = threading.Lock()
+        # Per-peer gossip drops: overflow drops were previously silent,
+        # which made partitions invisible — now counted per peer and
+        # surfaced in the RPC health view (system_health.gossipDropped)
+        # and the metrics exposition.
+        self.m_gossip_dropped = m.LabeledCounter(
+            "cess_gossip_dropped",
+            "gossip messages dropped per peer (queue overflow)",
+            label="peer", registry=service.registry,
+        )
+        self.m_chaos_injected = m.LabeledCounter(
+            "cess_chaos_injected",
+            "chaos faults injected per peer (node/faults.py)",
+            label="peer", registry=service.registry,
+        )
         # One single-worker pool PER PEER: gossip to a given peer is
         # delivered in submission order (a same-signer extrinsic burst
         # must not arrive nonce-reversed at a strict-nonce intake), it
@@ -332,14 +365,27 @@ class SyncManager:
 
     # ------------------------------------------------------ gossip out
 
+    @staticmethod
+    def _peer_label(peer) -> str:
+        return f"{peer[0]}:{peer[1]}"
+
     def _cast(self, method: str, params: list) -> None:
         """Fire-and-forget to every peer via its ordered gossip queue:
         the authoring loop must never block on a peer's import time
-        (the receiving handler verifies + re-executes synchronously)."""
+        (the receiving handler verifies + re-executes synchronously).
+        Overflow drops are counted per peer (m_gossip_dropped) so a
+        backed-up link shows up in the health view instead of failing
+        silently; a chaos injector (node/faults.py) may additionally
+        drop, delay, duplicate, or reorder each message."""
 
-        def one(peer):
+        def one(peer, delay, msg):
             try:
-                _rpc(*peer, method, params, GOSSIP_TIMEOUT_S)
+                if delay:
+                    # injected link latency: sleeping in the peer's own
+                    # single worker backs up only that peer's queue,
+                    # exactly like a slow real link
+                    time.sleep(delay)
+                _rpc(*peer, msg[0], msg[1], GOSSIP_TIMEOUT_S)
             except _rpc_errors():
                 pass
             finally:
@@ -347,15 +393,31 @@ class SyncManager:
                     self._queued[peer] -= 1
 
         for peer in self.peers:
-            with self._queue_lock:
-                if self._queued[peer] >= GOSSIP_QUEUE_MAX:
-                    continue  # hung peer: drop rather than queue forever
-                self._queued[peer] += 1
-            try:
-                self._pools[peer].submit(one, peer)
-            except RuntimeError:  # pool shut down during service stop
+            sends = [(0.0, (method, params))]
+            if self.faults is not None:
+                shape = self.faults.shape_gossip(peer, (method, params))
+                sends = shape.sends
+                if shape.faults:
+                    self.m_chaos_injected.inc(
+                        self._peer_label(peer), len(shape.faults))
+            for delay, msg in sends:
                 with self._queue_lock:
-                    self._queued[peer] -= 1
+                    if self._queued[peer] >= GOSSIP_QUEUE_MAX:
+                        # hung peer: drop rather than queue forever —
+                        # counted, so partitions are observable
+                        self.m_gossip_dropped.inc(self._peer_label(peer))
+                        continue
+                    self._queued[peer] += 1
+                try:
+                    self._pools[peer].submit(one, peer, delay, msg)
+                except RuntimeError:  # pool shut down during service stop
+                    with self._queue_lock:
+                        self._queued[peer] -= 1
+
+    def drop_counts(self) -> dict[str, int]:
+        """peer → gossip messages dropped on queue overflow (the RPC
+        health view's partition-visibility feed)."""
+        return self.m_gossip_dropped.counts()
 
     def announce_block(self, block: Block) -> None:
         self._cast("sync_announce", [block.to_json()])
@@ -372,11 +434,55 @@ class SyncManager:
     def broadcast_justification(self, just: Justification) -> None:
         self._cast("sync_justification", [just.to_json()])
 
+    def broadcast_offence(self, report) -> None:
+        """Offence-report gossip (chain/offences.py OffenceReport): the
+        evidence is self-verifying, so even a keyless observer's
+        detection reaches a validator who can submit the extrinsic."""
+        self._cast("sync_offence", [report.to_json()])
+
     # ------------------------------------------------------ catch-up
+
+    def _peer_call(self, host: str, port: int, method: str, params: list,
+                   timeout: float, attempts: int = CATCHUP_RPC_ATTEMPTS):
+        """Catch-up RPC with bounded retry: transient socket errors
+        (refused/timeout/chaos-injected) back off exponentially with
+        DETERMINISTIC jitter — blake2b(peer, method, attempt), so two
+        replicas replaying the same schedule behave identically — and
+        give up after `attempts`.  Definitive replies (RpcError and
+        malformed-shape errors) raise immediately: the peer answered,
+        retrying won't change its mind."""
+        from .rpc import RpcError
+
+        last: OSError | None = None
+        for attempt in range(max(1, attempts)):
+            if attempt:
+                frac = int.from_bytes(hashlib.blake2b(
+                    f"{host}:{port}/{method}/{attempt}".encode(),
+                    digest_size=2,
+                ).digest(), "big") / 0xFFFF
+                time.sleep(
+                    CATCHUP_BACKOFF_BASE_S * (2 ** (attempt - 1))
+                    * (1.0 + frac)
+                )
+            try:
+                if self.faults is not None:
+                    self.faults.rpc_gate((host, port), method)
+                return _rpc(host, port, method, params, timeout)
+            except RpcError:
+                raise
+            except OSError as e:
+                last = e
+        raise last
 
     def _peer_status(self, host: str, port: int) -> dict | None:
         try:
-            st = _rpc(host, port, "sync_status", [], GOSSIP_TIMEOUT_S)
+            # single attempt ON PURPOSE: the status probe runs against
+            # EVERY peer each catch-up lap, so a dead peer must cost
+            # one timeout, not a retry ladder — the next lap re-polls
+            # anyway.  (Still routed through _peer_call so the chaos
+            # injector's rpc_gate shapes it.)
+            st = self._peer_call(host, port, "sync_status", [],
+                                 GOSSIP_TIMEOUT_S, attempts=1)
         except _rpc_errors():
             return None
         # peer-controlled JSON: pin the shape before anyone indexes it
@@ -481,7 +587,8 @@ class SyncManager:
                         allow_batch = False
             n = s.head_number() + 1
             try:
-                d = _rpc(host, port, "sync_block", [n], GOSSIP_TIMEOUT_S)
+                d = self._peer_call(host, port, "sync_block", [n],
+                                    GOSSIP_TIMEOUT_S)
             except _rpc_errors():
                 break
             try:
@@ -540,8 +647,8 @@ class SyncManager:
         if count < 2:
             return -1
         try:
-            items = _rpc(host, port, "sync_block_range", [start, count],
-                         GOSSIP_TIMEOUT_S * 4)
+            items = self._peer_call(host, port, "sync_block_range",
+                                    [start, count], GOSSIP_TIMEOUT_S * 4)
         except _rpc_errors():
             return -2
         if not isinstance(items, list) or len(items) < 2:
@@ -618,7 +725,8 @@ class SyncManager:
         ):
             return
         try:
-            d = _rpc(host, port, "sync_block", [peer_fin], GOSSIP_TIMEOUT_S)
+            d = self._peer_call(host, port, "sync_block", [peer_fin],
+                                GOSSIP_TIMEOUT_S)
         except _rpc_errors():
             return
         j = d.get("justification") if isinstance(d, dict) else None
@@ -645,7 +753,8 @@ class SyncManager:
             if ours is None:
                 continue
             try:
-                d = _rpc(host, port, "sync_block", [n], GOSSIP_TIMEOUT_S)
+                d = self._peer_call(host, port, "sync_block", [n],
+                                    GOSSIP_TIMEOUT_S)
             except _rpc_errors():
                 return False
             try:
@@ -663,7 +772,7 @@ class SyncManager:
         """Warp-sync: restore the peer's versioned state blob and anchor
         the head so subsequent imports chain onto it."""
         try:
-            d = _rpc(host, port, "sync_checkpoint", [], 30.0)
+            d = self._peer_call(host, port, "sync_checkpoint", [], 30.0)
         except _rpc_errors():
             return False
         try:

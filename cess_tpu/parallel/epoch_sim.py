@@ -29,6 +29,14 @@ One storage-network epoch's device workload — "1M segments RS-recover +
                 header-audit shape: an entire epoch of headers costs
                 1 + #authors pairings instead of 2 per block.
 
+  stage OFFENCE the epoch's accumulated equivocation evidence
+                (chain/offences.py OffenceReport: two signatures over
+                conflicting consensus payloads per report) swept in
+                ONE weighted signature batch — 2N pairings collapse to
+                1 + #offenders, the shape an era-boundary conviction
+                pass would use to re-verify a backlog of reports —
+                plus the host-side structural conflict checks.
+
 Every stage is checked against host arithmetic when `check=True` (the
 default — tests run tiny geometries on the virtual 8-device CPU mesh);
 production-scale runs set check=False and read the timing breakdown.
@@ -65,12 +73,14 @@ class EpochReport:
     bls_ok: bool
     headers: int = 0
     vrf_ok: bool = True
+    offences: int = 0
+    offences_ok: bool = True
     seconds: dict[str, float] = field(default_factory=dict)
 
     @property
     def ok(self) -> bool:
         return (self.rs_ok and self.combine_ok and self.sigma_ok
-                and self.bls_ok and self.vrf_ok)
+                and self.bls_ok and self.vrf_ok and self.offences_ok)
 
 
 # ------------------------------------------------------------ RS stage
@@ -114,6 +124,7 @@ def run_epoch(
     n_keys: int = 2,
     n_headers: int = 64,
     n_validators: int = 3,
+    n_offences: int = 8,
     seed: int = 7,
     check: bool = True,
 ) -> EpochReport:
@@ -226,6 +237,43 @@ def run_epoch(
             _vrf.verify(*claims[i]) for i in (0, n_headers - 1)
         )
 
+    # ---------- stage OFFENCE: the era's equivocation evidence, one batch
+    from ..chain import offences as _off
+
+    n_offences = r(n_offences)
+    off_triples = []
+    offences_ok = True
+    for i in range(n_offences):
+        k = i % n_validators
+        sk, pk = vkeys[k], vpks[k]
+        # two conflicting finality payloads (same height, different
+        # hash) signed by the same offender — the OffenceReport shape
+        p1 = b'["epoch-sim","finality",%d,"aa%02x"]' % (i, i & 0xFF)
+        p2 = b'["epoch-sim","finality",%d,"bb%02x"]' % (i, i & 0xFF)
+        offences_ok = offences_ok and p1 != p2  # structural conflict
+        off_triples.append((pk, p1, bls.sign(sk, p1)))
+        off_triples.append((pk, p2, bls.sign(sk, p2)))
+    t0 = time.perf_counter()
+    if off_triples:
+        offences_ok = offences_ok and bls_agg.batch_verify_signatures(
+            off_triples, b"offences-%d" % seed, mesh=mesh
+        )
+    seconds["offence_sweep"] = time.perf_counter() - t0
+    if check and n_offences:
+        # one report must also survive the pallet's full structural
+        # verifier (host path) — the batch and the per-report gate
+        # must agree
+        rep = _off.OffenceReport(
+            kind=_off.KIND_VOTE_EQUIV, offender="v0", session=0,
+            evidence=[
+                [off_triples[0][1].hex(), off_triples[0][2].hex()],
+                [off_triples[1][1].hex(), off_triples[1][2].hex()],
+            ],
+        )
+        offences_ok = offences_ok and _off.verify_report(
+            rep, "epoch-sim", {"v0": vpks[0]}.get
+        )
+
     return EpochReport(
         n_devices=n_dev,
         segments=n_segments,
@@ -238,5 +286,7 @@ def run_epoch(
         bls_ok=bls_ok,
         headers=n_headers,
         vrf_ok=vrf_ok,
+        offences=n_offences,
+        offences_ok=offences_ok,
         seconds=seconds,
     )

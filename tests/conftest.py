@@ -44,6 +44,13 @@ def pytest_configure(config):
         "consensus: fast VRF/slot-claim unit tests — CI runs these as "
         "their own gate even when the slow testnet e2e is skipped",
     )
+    config.addinivalue_line(
+        "markers",
+        "offences: offences/liveness/chaos suite "
+        "(tests/test_offences.py, test_faults.py, test_zz_offences_*, "
+        "test_zz_chaos_*) — CI runs these as their own fast gate so a "
+        "liveness regression fails loudly",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -62,13 +62,14 @@ class TestEpochSim:
         assert report.sigma_ok, "sharded sigma fold diverged from host"
         assert report.bls_ok, "aggregate BLS verification failed"
         assert report.vrf_ok, "VRF header batch verification failed"
+        assert report.offences_ok, "offence evidence sweep failed"
         assert report.ok
         assert report.n_devices == 8
         assert report.segments == 16 and report.proofs == 16
         assert report.headers == 8
         assert set(report.seconds) == {
             "rs", "audit_combine", "sigma_fold", "bls_aggregate",
-            "vrf_headers",
+            "vrf_headers", "offence_sweep",
         }
 
     def test_batch_sizes_round_up_to_mesh(self, mesh):

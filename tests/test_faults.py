@@ -1,0 +1,126 @@
+"""Deterministic fault injection (cess_tpu/node/faults.py): identical
+seeds reproduce identical fault schedules — the property that makes a
+chaos-soak failure replayable — plus partition semantics, profile
+gating, and the crash schedule."""
+
+import pytest
+
+from cess_tpu.node.faults import (
+    PROFILES,
+    ChaosError,
+    ChaosProfile,
+    FaultInjector,
+    crash_schedule,
+)
+
+pytestmark = pytest.mark.offences
+
+PEERS = [("127.0.0.1", 9001), ("127.0.0.1", 9002), ("10.0.0.3", 9001)]
+
+
+def gossip_trace(seed, profile, n=400):
+    """The full decision stream for a fixed call sequence."""
+    inj = FaultInjector(seed, profile)
+    trace = []
+    for i in range(n):
+        peer = PEERS[i % len(PEERS)]
+        shape = inj.shape_gossip(peer, ("m", [i]))
+        trace.append((
+            peer, tuple(shape.faults),
+            tuple((round(d, 9), m[1][0]) for d, m in shape.sends),
+        ))
+    return trace, inj
+
+
+def rpc_trace(seed, profile, n=200):
+    inj = FaultInjector(seed, profile)
+    out = []
+    for i in range(n):
+        peer = PEERS[i % len(PEERS)]
+        try:
+            inj.rpc_gate(peer, "sync_block")
+            out.append("ok")
+        except ChaosError:
+            out.append("drop")
+    return out
+
+
+class TestDeterminism:
+    def test_same_seed_same_gossip_schedule(self):
+        t1, i1 = gossip_trace(42, "hostile")
+        t2, i2 = gossip_trace(42, "hostile")
+        assert t1 == t2
+        assert i1.injected == i2.injected > 0
+
+    def test_same_seed_same_rpc_schedule(self):
+        assert rpc_trace(42, "hostile") == rpc_trace(42, "hostile")
+        assert "drop" in rpc_trace(42, "hostile")
+
+    def test_different_seeds_diverge(self):
+        assert gossip_trace(42, "hostile")[0] != gossip_trace(43, "hostile")[0]
+
+    def test_crash_schedule_deterministic_and_spares_node_zero(self):
+        s1 = crash_schedule(1234, 3)
+        assert s1 == crash_schedule(1234, 3)
+        assert len(s1) == 1
+        victim, at_block = s1[0]
+        assert 1 <= victim < 3 and at_block >= 6
+        assert crash_schedule(1234, 1) == []
+
+
+class TestSemantics:
+    def test_off_profile_injects_nothing(self):
+        trace, inj = gossip_trace(7, "off")
+        assert inj.injected == 0
+        # every message sent exactly once, immediately, in order
+        assert all(
+            faults == () and len(sends) == 1 and sends[0][0] == 0.0
+            for _, faults, sends in trace
+        )
+        assert rpc_trace(7, "off") == ["ok"] * 200
+
+    def test_every_fault_kind_appears_under_hostility(self):
+        trace, _ = gossip_trace(42, "hostile", n=600)
+        kinds = {f for _, faults, _ in trace for f in faults}
+        assert {"drop", "delay", "duplicate", "hold", "release",
+                "partition"} <= kinds
+
+    def test_partition_cuts_both_planes(self):
+        """A profile that ONLY partitions: when a window opens, gossip
+        and catch-up RPC to that peer both fail for the window."""
+        prof = ChaosProfile("part-only", partition=1.0, partition_len=3)
+        inj = FaultInjector(9, prof)
+        peer = PEERS[0]
+        results = []
+        for i in range(12):
+            if i % 2 == 0:
+                shape = inj.shape_gossip(peer, ("m", [i]))
+                results.append(
+                    "cut" if "partition" in shape.faults else "ok")
+            else:
+                try:
+                    inj.rpc_gate(peer, "sync_status")
+                    results.append("ok")
+                except ChaosError:
+                    results.append("cut")
+        assert "cut" in results  # windows open
+        assert "ok" in results   # and close again
+
+    def test_reorder_swaps_adjacent_messages(self):
+        prof = ChaosProfile("reorder-only", reorder=1.0)
+        inj = FaultInjector(11, prof)
+        peer = PEERS[0]
+        first = inj.shape_gossip(peer, ("m", ["a"]))
+        assert first.sends == [] and "hold" in first.faults
+        second = inj.shape_gossip(peer, ("m", ["b"]))
+        sent = [m[1][0] for _, m in second.sends]
+        # b dispatches before the held-back a: the adjacent swap
+        assert sent == ["b", "a"]
+
+    def test_profiles_registry(self):
+        assert set(PROFILES) == {"off", "light", "mild", "hostile"}
+        assert PROFILES["hostile"].drop > PROFILES["mild"].drop
+        # "light" is the sustained-soak profile: lossy link only, no
+        # partitions (those are asserted above in this file instead)
+        assert PROFILES["light"].partition == 0.0
+        assert PROFILES["light"].drop > 0

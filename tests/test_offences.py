@@ -1,0 +1,482 @@
+"""Offences pipeline (chain/{offences,session,staking}.py): portable
+evidence verification, registry dedup, heartbeat liveness sweep,
+deferred era-boundary conviction, escalating slashes, chills, the
+bags-shaped election at scale, and the checkpoint v3→v4 migration.
+
+Chain-level and host-BLS only — the expensive pairings are two per
+evidence report, so the whole file stays in the fast offences CI gate
+(`pytest -m offences`)."""
+
+import copy
+import json
+
+import pytest
+
+from cess_tpu.chain import checkpoint
+from cess_tpu.chain import offences as off
+from cess_tpu.chain.runtime import Runtime, RuntimeConfig, session_plan
+from cess_tpu.chain.types import DispatchError, TOKEN
+from cess_tpu.ops import bls12_381 as bls
+
+pytestmark = pytest.mark.offences
+
+GENESIS = "test-genesis"
+
+
+def keypair(name: str):
+    sk = bls.keygen(f"offence-test-{name}".encode())
+    return sk, bls.sk_to_pk(sk)
+
+
+KEYS = {n: keypair(n) for n in ("alice", "bob", "charlie", "dave")}
+PUBS = {n: pk for n, (sk, pk) in KEYS.items()}
+
+
+def canonical(obj) -> bytes:
+    return json.dumps(obj, sort_keys=True, separators=(",", ":")).encode()
+
+
+def finality_payload(number: int, block_hash: str,
+                     genesis: str = GENESIS) -> bytes:
+    return canonical([genesis, "finality", number, block_hash])
+
+
+def block_payload(number: int, slot: int, author: str, salt: str = "",
+                  genesis: str = GENESIS) -> bytes:
+    return canonical([genesis, "block", number, slot, "parent" + salt,
+                      author, "extroot", "statehash", "out", "proof"])
+
+
+def vote_equiv_report(offender: str, number: int, session: int,
+                      h1: str = "aa", h2: str = "bb") -> off.OffenceReport:
+    sk, _ = KEYS[offender]
+    p1, p2 = finality_payload(number, h1), finality_payload(number, h2)
+    return off.OffenceReport(
+        kind=off.KIND_VOTE_EQUIV, offender=offender, session=session,
+        evidence=[[p1.hex(), bls.sign(sk, p1).hex()],
+                  [p2.hex(), bls.sign(sk, p2).hex()]],
+    )
+
+
+def block_equiv_report(offender: str, number: int, slot: int,
+                       session: int) -> off.OffenceReport:
+    sk, _ = KEYS[offender]
+    p1 = block_payload(number, slot, offender, salt="1")
+    p2 = block_payload(number, slot, offender, salt="2")
+    return off.OffenceReport(
+        kind=off.KIND_BLOCK_EQUIV, offender=offender, session=session,
+        evidence=[[p1.hex(), bls.sign(sk, p1).hex()],
+                  [p2.hex(), bls.sign(sk, p2).hex()]],
+    )
+
+
+def make_rt(era: int = 8, validators=("alice", "bob", "charlie"),
+            candidates=(), **kw) -> Runtime:
+    rt = Runtime(RuntimeConfig(
+        era_duration_blocks=era,
+        genesis_validators=list(validators),
+        genesis_candidates=list(candidates),
+        **kw,
+    ))
+    rt.offences.evidence_verifier = (
+        lambda rep: off.verify_report(rep, GENESIS, PUBS.get)
+    )
+    return rt
+
+
+class TestEvidenceVerification:
+    def test_genuine_vote_equivocation_verifies(self):
+        rep = vote_equiv_report("charlie", 4, 1)
+        assert off.verify_report(rep, GENESIS, PUBS.get)
+        assert off.evidence_height(rep) == 4
+
+    def test_genuine_block_equivocation_verifies(self):
+        rep = block_equiv_report("bob", 7, 12, 1)
+        assert off.verify_report(rep, GENESIS, PUBS.get)
+        assert off.evidence_height(rep) == 7
+
+    def test_forged_signature_refused(self):
+        rep = vote_equiv_report("charlie", 4, 1)
+        # dave signs charlie's "second vote": the conflict is no longer
+        # attributable to charlie
+        p2 = finality_payload(4, "bb")
+        rep.evidence[1] = [p2.hex(), bls.sign(KEYS["dave"][0], p2).hex()]
+        assert not off.verify_report(rep, GENESIS, PUBS.get)
+
+    def test_same_payload_twice_is_not_a_conflict(self):
+        sk, _ = KEYS["charlie"]
+        p = finality_payload(4, "aa")
+        rep = off.OffenceReport(
+            kind=off.KIND_VOTE_EQUIV, offender="charlie", session=1,
+            evidence=[[p.hex(), bls.sign(sk, p).hex()]] * 2,
+        )
+        assert not off.verify_report(rep, GENESIS, PUBS.get)
+
+    def test_votes_for_different_heights_refused(self):
+        sk, _ = KEYS["charlie"]
+        p1, p2 = finality_payload(4, "aa"), finality_payload(8, "bb")
+        rep = off.OffenceReport(
+            kind=off.KIND_VOTE_EQUIV, offender="charlie", session=1,
+            evidence=[[p1.hex(), bls.sign(sk, p1).hex()],
+                      [p2.hex(), bls.sign(sk, p2).hex()]],
+        )
+        assert not off.verify_report(rep, GENESIS, PUBS.get)
+
+    def test_other_chain_evidence_refused(self):
+        sk, _ = KEYS["charlie"]
+        p1 = finality_payload(4, "aa", genesis="other-chain")
+        p2 = finality_payload(4, "bb", genesis="other-chain")
+        rep = off.OffenceReport(
+            kind=off.KIND_VOTE_EQUIV, offender="charlie", session=1,
+            evidence=[[p1.hex(), bls.sign(sk, p1).hex()],
+                      [p2.hex(), bls.sign(sk, p2).hex()]],
+        )
+        assert not off.verify_report(rep, GENESIS, PUBS.get)
+
+    def test_block_evidence_for_different_slots_refused(self):
+        sk, _ = KEYS["bob"]
+        p1 = block_payload(7, 12, "bob")
+        p2 = canonical([GENESIS, "block", 7, 13, "parent", "bob",
+                        "extroot", "statehash", "out", "proof"])
+        rep = off.OffenceReport(
+            kind=off.KIND_BLOCK_EQUIV, offender="bob", session=1,
+            evidence=[[p1.hex(), bls.sign(sk, p1).hex()],
+                      [p2.hex(), bls.sign(sk, p2).hex()]],
+        )
+        assert not off.verify_report(rep, GENESIS, PUBS.get)
+
+    def test_unknown_offender_and_malformed_evidence_refused(self):
+        rep = vote_equiv_report("charlie", 4, 1)
+        assert not off.verify_report(rep, GENESIS, {}.get)
+        rep.evidence[0][0] = "zz-not-hex"
+        assert not off.verify_report(rep, GENESIS, PUBS.get)
+
+    def test_report_json_roundtrip(self):
+        rep = vote_equiv_report("charlie", 4, 1)
+        again = off.OffenceReport.from_json(rep.to_json())
+        assert again == rep and again.key() == rep.key()
+
+
+class TestRegistryAndDispatch:
+    """The on-chain intake: every failure mode must be a deterministic
+    DispatchError (a failed receipt on every replica), never a slash."""
+
+    def test_verified_report_queues_and_applies_at_era_boundary(self):
+        rt = make_rt()  # era 8 → session_length 4
+        rep = vote_equiv_report("charlie", 4, 1)
+        rt.run_blocks(5)  # session 1 current, era not yet ended
+        rt.offences.report_offence("alice", rep.to_json())
+        assert rt.offences.pending  # queued, NOT applied
+        assert rt.staking.ledger["charlie"].bonded == 10_000 * TOKEN
+        rt.run_blocks(3)  # block 8: era boundary applies convictions
+        assert not rt.offences.pending
+        assert rt.staking.ledger["charlie"].bonded == 9_500 * TOKEN
+        assert rt.state.balances.free("pot/treasury") == 500 * TOKEN
+        assert rt.staking.is_chilled("charlie")
+
+    def test_forged_report_is_noop(self):
+        rt = make_rt()
+        rt.run_blocks(5)
+        rep = vote_equiv_report("charlie", 4, 1)
+        rep.evidence[1][1] = rep.evidence[0][1]  # mismatched signature
+        with pytest.raises(DispatchError, match="UnverifiableEvidence"):
+            rt.offences.report_offence("alice", rep.to_json())
+        rt.run_blocks(3)
+        assert rt.staking.ledger["charlie"].bonded == 10_000 * TOKEN
+        assert not rt.offences.reports
+
+    def test_replayed_report_is_noop(self):
+        rt = make_rt()
+        rt.run_blocks(5)
+        rep = vote_equiv_report("charlie", 4, 1)
+        rt.offences.report_offence("alice", rep.to_json())
+        with pytest.raises(DispatchError, match="DuplicateOffence"):
+            rt.offences.report_offence("bob", rep.to_json())
+        # a SECOND honest reporter replaying after application is
+        # still refused — one conviction per (kind, offender, session)
+        rt.run_blocks(3)
+        bonded = rt.staking.ledger["charlie"].bonded
+        with pytest.raises(DispatchError, match="DuplicateOffence"):
+            rt.offences.report_offence("dave", rep.to_json())
+        rt.run_blocks(8)
+        assert rt.staking.ledger["charlie"].bonded == bonded
+
+    def test_pruned_horizon_cannot_double_convict(self):
+        """The registry prune and the evidence-acceptance window must
+        agree at the boundary: a record AT the horizon survives the
+        prune (the session is still reportable, so dropping it would
+        let a stored old report slash the same offender twice)."""
+        rt = make_rt()
+        rt.run_blocks(5)
+        rep = vote_equiv_report("charlie", 4, 1)
+        rt.offences.report_offence("alice", rep.to_json())
+        rt.run_blocks(3)  # era boundary: applied
+        # fast-forward the session clock to the exact horizon
+        rt.session.session_index = 1 + off.REPORT_HISTORY_SESSIONS
+        rt.offences.apply_pending()  # prune pass
+        with pytest.raises(DispatchError, match="DuplicateOffence"):
+            rt.offences.report_offence("bob", rep.to_json())
+        # one session further: the record may drop, but acceptance
+        # rejects the session too — still no double conviction
+        rt.session.session_index += 1
+        rt.offences.apply_pending()
+        with pytest.raises(DispatchError, match="SessionOutOfRange"):
+            rt.offences.report_offence("bob", rep.to_json())
+
+    def test_wrong_session_refused(self):
+        rt = make_rt()
+        rt.run_blocks(5)
+        rep = vote_equiv_report("charlie", 4, 0)  # height 4 is session 1
+        with pytest.raises(DispatchError, match="WrongSession"):
+            rt.offences.report_offence("alice", rep.to_json())
+
+    def test_unresponsive_not_reportable_via_extrinsic(self):
+        rt = make_rt()
+        rep = vote_equiv_report("charlie", 4, 1)
+        rep.kind = off.KIND_UNRESPONSIVE
+        with pytest.raises(DispatchError, match="UnknownOffenceKind"):
+            rt.offences.report_offence("alice", rep.to_json())
+
+    def test_runtime_without_verifier_refuses_everything(self):
+        rt = make_rt()
+        rt.offences.evidence_verifier = None
+        rt.run_blocks(5)
+        with pytest.raises(DispatchError, match="UnverifiableEvidence"):
+            rt.offences.report_offence(
+                "alice", vote_equiv_report("charlie", 4, 1).to_json()
+            )
+
+    def test_escalating_slash_doubles_per_strike(self):
+        rt = make_rt()
+        rt.run_blocks(5)
+        rt.offences.report_offence(
+            "alice", vote_equiv_report("charlie", 4, 1).to_json())
+        rt.run_blocks(8)  # era 1 boundary: 5% of 10k
+        assert rt.staking.ledger["charlie"].bonded == 9_500 * TOKEN
+        # second conviction (a different session) escalates to 10%
+        rt.offences.report_offence(
+            "alice", vote_equiv_report("charlie", 13, 3).to_json())
+        rt.run_blocks(8)
+        assert rt.offences.strikes["charlie"] == 2
+        assert rt.staking.ledger["charlie"].bonded == 9_500 * TOKEN * 90 // 100
+
+
+class TestHeartbeatsAndSweep:
+    def test_heartbeat_gates(self):
+        rt = make_rt()
+        rt.run_blocks(1)
+        sess = rt.session.session_index
+        rt.offences.heartbeat("alice", sess)
+        with pytest.raises(DispatchError, match="DuplicateHeartbeat"):
+            rt.offences.heartbeat("alice", sess)
+        with pytest.raises(DispatchError, match="StaleHeartbeat"):
+            rt.offences.heartbeat("bob", sess + 1)
+        with pytest.raises(DispatchError, match="NotAnAuthority"):
+            rt.offences.heartbeat("dave", sess)
+
+    def test_silent_authority_chilled_out_of_next_election(self):
+        rt = make_rt(candidates=("alice", "bob", "charlie"))
+        for _ in range(8):
+            for who in ("alice", "bob"):  # charlie never heartbeats
+                sess = rt.session.session_index
+                if who not in rt.offences.heartbeats.get(sess, set()):
+                    rt.offences.heartbeat(who, sess)
+            rt.run_blocks(1)
+        assert ("unresponsive", "charlie", 0) in rt.offences.reports
+        assert rt.staking.is_chilled("charlie")
+        assert rt.staking.validators == ["alice", "bob"]
+        # chill also blocks re-candidacy until it expires
+        with pytest.raises(DispatchError, match="Chilled"):
+            rt.staking.validate("charlie")
+        # credit punishment recorded for the silent authority
+        entry = rt.scheduler_credit.current_counters.get("charlie")
+        assert entry is not None and entry.punishment_count >= 1
+
+    def test_zero_heartbeat_session_never_chills(self):
+        """Header-less sims and single-node dev chains never heartbeat;
+        the sweep must not chill their whole authority set."""
+        rt = make_rt(candidates=("alice", "bob", "charlie"))
+        rt.run_blocks(16)  # two full eras, no heartbeats at all
+        assert not rt.offences.reports
+        assert sorted(rt.staking.validators) == ["alice", "bob", "charlie"]
+
+    def test_minority_heartbeat_session_never_chills(self):
+        """Silence is only attributable when ≥ half the set heartbeat:
+        if most heartbeats are missing the NETWORK (or this fork) was
+        degraded — chilling then would collapse the authority set to
+        whoever's heartbeats happened to land and make a transient
+        partition permanent."""
+        rt = make_rt(candidates=("alice", "bob", "charlie"))
+        for _ in range(8):
+            sess = rt.session.session_index
+            if "alice" not in rt.offences.heartbeats.get(sess, set()):
+                rt.offences.heartbeat("alice", sess)  # 1 of 3 < half
+            rt.run_blocks(1)
+        assert not rt.offences.reports
+        assert sorted(rt.staking.validators) == ["alice", "bob", "charlie"]
+
+
+class TestElectionAtScale:
+    def test_bags_election_matches_global_sort_and_caps_whales(self):
+        rt = Runtime(RuntimeConfig(endowed={
+            f"v{i:03d}": 10_000_000 * TOKEN for i in range(40)
+        }))
+        import random
+        rnd = random.Random(7)
+        stakes = {}
+        for i in range(40):
+            name = f"v{i:03d}"
+            stakes[name] = rnd.randrange(5_000, 4_000_000) * TOKEN
+            rt.staking.bond(name, name, stakes[name])
+            rt.staking.validate(name)
+        elected = rt.staking.elect(12)
+        cap = rt.staking.max_candidate_backing
+        want = sorted(
+            ((min(st, cap), n) for n, st in stakes.items()),
+            key=lambda t: (-t[0], t[1]),
+        )[:12]
+        assert elected == [n for _, n in want]
+
+    def test_all_candidates_chilled_keeps_previous_set(self):
+        rt = make_rt(candidates=("alice", "bob"))
+        rt.run_blocks(8)
+        assert sorted(rt.staking.validators) == ["alice", "bob"]
+        for v in ("alice", "bob"):
+            rt.staking.force_chill(v, rt.staking.active_era + 5)
+        before = list(rt.staking.validators)
+        rt.run_blocks(8)
+        assert rt.staking.validators == before  # liveness over rotation
+
+
+class TestReplicaConvergenceSim:
+    """The acceptance sim: 100+ validators, an offline third chilled
+    out of the next election, a proven equivocator slashed with
+    bit-identical balances on every replica, and the chain still
+    advancing."""
+
+    N = 120
+
+    def build(self) -> Runtime:
+        names = [f"val{i:03d}" for i in range(self.N)]
+        rt = Runtime(RuntimeConfig(
+            era_duration_blocks=8,
+            genesis_validators=names,
+            genesis_candidates=names,
+        ))
+        rt.offences.evidence_verifier = (
+            lambda rep: off.verify_report(rep, GENESIS, PUBS.get)
+        )
+        return rt
+
+    def drive(self, rt: Runtime) -> None:
+        names = [f"val{i:03d}" for i in range(self.N)]
+        online = set(names[: 2 * self.N // 3])  # last third is offline
+        equivocator = names[0]
+        sk, pk = keypair("sim-equivocator")
+        # the equivocator's conflicting votes at height 4 (session 1)
+        p1, p2 = finality_payload(4, "aa"), finality_payload(4, "bb")
+        rep = off.OffenceReport(
+            kind=off.KIND_VOTE_EQUIV, offender=equivocator, session=1,
+            evidence=[[p1.hex(), bls.sign(sk, p1).hex()],
+                      [p2.hex(), bls.sign(sk, p2).hex()]],
+        )
+        rt.offences.evidence_verifier = (
+            lambda r: off.verify_report(r, GENESIS, {equivocator: pk}.get)
+        )
+        reported = False
+        for _ in range(17):
+            sess = rt.session.session_index
+            beats = rt.offences.heartbeats.get(sess, set())
+            for who in online:
+                # the equivocator is chilled out mid-sim; only seated
+                # authorities may heartbeat
+                if who not in beats and who in rt.staking.validators:
+                    rt.offences.heartbeat(who, sess)
+            if not reported and rt.session.session_index >= 1:
+                rt.offences.report_offence(names[1], rep.to_json())
+                reported = True
+            rt.run_blocks(1)
+
+    def test_sim_chills_slashes_and_converges(self):
+        r1, r2 = self.build(), self.build()
+        self.drive(r1)
+        self.drive(r2)
+        names = [f"val{i:03d}" for i in range(self.N)]
+        offline = names[2 * self.N // 3:]
+        # every offline validator was chilled out of the election: the
+        # candidacy is gone (re-validate is the only way back after the
+        # chill lapses) and none are in the elected set
+        assert not (set(offline) & set(r1.staking.candidates))
+        assert not (set(offline) & set(r1.staking.validators))
+        assert all(
+            ("unresponsive", v, 0) in r1.offences.reports for v in offline
+        )
+        # the elected set is the online two-thirds, minus the (also
+        # chilled) equivocator
+        assert len(r1.staking.validators) == 2 * self.N // 3 - 1
+        assert names[0] not in r1.staking.validators
+        assert r1.staking.is_chilled(names[0])
+        # the equivocator lost exactly 5% of its bond, to treasury
+        assert (r1.staking.ledger[names[0]].bonded
+                == 9_500 * TOKEN)
+        assert r1.state.balances.free("pot/treasury") == 500 * TOKEN
+        # chain advanced through two eras
+        assert r1.staking.active_era >= 2
+        assert r1.state.block_number == 17
+        # BIT-IDENTICAL state across replicas — balances included
+        assert (checkpoint.state_hash(r1)
+                == checkpoint.state_hash(r2))
+
+
+class TestSessionPlanAndMigration:
+    def test_session_plan_products(self):
+        for era in (1, 2, 4, 8, 12, 600, 3600):
+            s, k = session_plan(era)
+            assert s * k == era
+        assert session_plan(3600) == (600, 6)
+        assert session_plan(8, sessions_per_era=4) == (2, 4)
+
+    def test_checkpoint_v3_blob_migrates(self):
+        """A pre-offences (v3) snapshot restores into this build with
+        empty offence/heartbeat/session state and an identical chain
+        state hash on every replica (the v2-migration test pattern,
+        tests/test_zz_consensus.py)."""
+        rt = make_rt(candidates=("alice", "bob"))
+        rt.run_blocks(5)
+        rt.offences.heartbeat("alice", rt.session.session_index)
+        payload_version, data = checkpoint.decode_blob(
+            checkpoint.snapshot(rt))
+        assert payload_version == checkpoint.FORMAT_VERSION == 4
+        # strip everything a v3 writer never emitted
+        data.pop("session")
+        data.pop("offences")
+        data["staking"].pop("chilled_until")
+        out: list[bytes] = []
+        checkpoint._canon(data, out)
+        v3 = checkpoint.MAGIC + (3).to_bytes(2, "big") + b"".join(out)
+        fresh = make_rt(candidates=("alice", "bob"))
+        checkpoint.restore(fresh, v3)
+        assert fresh.offences.reports == {}
+        assert fresh.offences.heartbeats == {}
+        assert fresh.offences.strikes == {}
+        assert fresh.session.session_index == 0
+        assert fresh.staking.chilled_until == {}
+        assert fresh.state.block_number == 5
+        # two replicas restoring the same migrated blob are bit-identical
+        again = make_rt(candidates=("alice", "bob"))
+        checkpoint.restore(again, v3)
+        assert (checkpoint.state_hash(fresh)
+                == checkpoint.state_hash(again))
+
+    def test_v4_blob_roundtrips_offence_state(self):
+        rt = make_rt()
+        rt.run_blocks(5)
+        rt.offences.report_offence(
+            "alice", vote_equiv_report("charlie", 4, 1).to_json())
+        blob = checkpoint.snapshot(rt)
+        fresh = make_rt()
+        checkpoint.restore(fresh, blob)
+        assert checkpoint.state_hash(fresh) == checkpoint.state_hash(rt)
+        assert ("equivocation.vote", "charlie", 1) in fresh.offences.reports
+        # wiring did not travel: the fresh verifier closure is intact
+        assert fresh.offences.evidence_verifier is not None

@@ -1,0 +1,334 @@
+"""Chaos soak: a CLI-launched 3-process testnet under seeded fault
+injection (sustained drop / delay / duplicate gossip — the "light"
+profile; partitions and reorder are exercised by tests/test_faults.py)
+with one crash-restart from the seed's crash schedule, one
+deliberately SILENT validator (--chaos-mute), and one EQUIVOCATING
+validator (conflicting finality votes injected over RPC) must still:
+
+  * complete a full audit round (challenge → prove → verify → reward),
+  * rotate an epoch (genesis candidacies make the election real),
+  * slash the equivocator and chill the silent node on every replica,
+  * converge to ONE finalized state hash.
+
+The fault schedule is reproducible: the printed seed re-creates it
+exactly (determinism itself is asserted in tests/test_faults.py).
+
+Sorts last (zz) so a tier-1 timeout truncates it, not the broad suite."""
+
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from cess_tpu.node.chain_spec import _spec, dev_sk
+from cess_tpu.node.client import MinerClient, TeeClient
+from cess_tpu.node.faults import crash_schedule
+from cess_tpu.node.rpc import RpcError, rpc_call
+from cess_tpu.node.sync import finality_payload
+from cess_tpu.chain.types import TOKEN
+from cess_tpu.ops import bls12_381 as bls
+from cess_tpu.ops.podr2 import Podr2Params
+
+pytestmark = pytest.mark.offences
+
+PARAMS = Podr2Params(n=8, s=4)
+BLOCK_MS = 800
+HOST = "127.0.0.1"
+CHAOS_SEED = 20260804
+VALIDATORS = ["alice", "bob", "charlie"]
+SILENT = "bob"          # --chaos-mute: never heartbeats → chilled
+EQUIVOCATOR = "charlie"  # double-votes → slashed
+
+
+def free_ports(n: int) -> list[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind((HOST, 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def build_spec_file(tmp_path) -> str:
+    spec = _spec(
+        "chaos", "CESS-TPU Chaos Soak",
+        accounts=["alice", "bob", "charlie", "miner-0",
+                  "tee-stash", "tee-ctrl"],
+        validators=VALIDATORS,
+        block_time_ms=BLOCK_MS,
+    )
+    spec.finality_period = 4
+    spec.genesis = {
+        "one_day_block": 20,       # ~50% challenge trigger per block
+        "podr2_chunk_count": PARAMS.n,
+        # NOTE: audit_lock_time stays at its default (10): a shorter
+        # OCW lock makes every trigger block a fresh proposal, and the
+        # pallet's stale-proposal purge then clears tallies faster
+        # than gossip-staggered votes can meet quorum
+        "era_duration_blocks": 8,
+        # ONE 8-block session per era: a wide heartbeat landing window,
+        # so honest-but-chaos-delayed heartbeats don't chill honest
+        # validators and flake the soak
+        "sessions_per_era": 1,
+        # candidacies make the era-boundary election REAL, so the
+        # chilled silent node actually drops out of the active set
+        "genesis_candidates": VALIDATORS,
+    }
+    path = tmp_path / "chaos-spec.json"
+    path.write_text(spec.to_json())
+    return str(path)
+
+
+def launch(spec_path: str, authority: str, port: int,
+           peer_ports: list[int]) -> subprocess.Popen:
+    peers = ",".join(f"{HOST}:{p}" for p in peer_ports)
+    args = [
+        sys.executable, "-m", "cess_tpu", "run",
+        "--chain", spec_path, "--rpc-port", str(port),
+        "--authority", authority, "--peers", peers,
+        # replay (batch-verified) catch-up rather than hair-trigger
+        # warp: a warp-synced node skips heights, so its audit OCW
+        # misses trigger blocks and its challenge votes stop aligning
+        # with the other validators' (warp itself is exercised by
+        # tests/test_zz_sync_testnet.py)
+        "--checkpoint-gap", "24",
+        "--chaos-seed", str(CHAOS_SEED), "--chaos-profile", "light",
+    ]
+    if authority == SILENT:
+        args.append("--chaos-mute")
+    return subprocess.Popen(
+        args, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        cwd="/root/repo", text=True,
+    )
+
+
+def wait_rpc(port: int, timeout: float = 120.0) -> None:
+    t0 = time.monotonic()
+    while True:
+        try:
+            rpc_call(HOST, port, "system_name", [], timeout=2.0)
+            return
+        except (OSError, RpcError):
+            if time.monotonic() - t0 > timeout:
+                raise TimeoutError(f"node on port {port} never came up")
+            time.sleep(0.5)
+
+
+def status(port: int) -> dict:
+    return rpc_call(HOST, port, "sync_status", [], timeout=5.0)
+
+
+def wait_for(pred, timeout: float, what: str, poll: float = 0.5):
+    t0 = time.monotonic()
+    while True:
+        try:
+            value = pred()
+        except (OSError, RpcError, ValueError):
+            # chaos: a node may be mid-restart, or its RPC handler may
+            # starve behind the service lock and close without a reply
+            value = None
+        if value:
+            return value
+        if time.monotonic() - t0 > timeout:
+            raise TimeoutError(f"timed out waiting for {what}")
+        time.sleep(poll)
+
+
+class TestChaosSoak:
+    def test_hostile_network_soak(self, tmp_path):
+        spec_path = build_spec_file(tmp_path)
+        ports = free_ports(3)
+        procs = {}
+        try:
+            for v, port in zip(VALIDATORS, ports):
+                procs[v] = launch(
+                    spec_path, v, port, [p for p in ports if p != port]
+                )
+            for port in ports:
+                wait_rpc(port)
+            port0 = ports[0]
+
+            # ---- liveness under faults: every node advances
+            wait_for(
+                lambda: min(status(p)["number"] for p in ports) >= 2,
+                150, "all nodes past block 2",
+            )
+
+            # ---- inject the equivocation: charlie double-votes a
+            # future finality boundary; alice's replica proves the
+            # conflict and routes the offence report
+            head = status(port0)["number"]
+            target = ((head // 4) + 2) * 4
+            sk = dev_sk(EQUIVOCATOR, "chaos")
+            genesis = rpc_call(HOST, port0, "system_chainGenesis", [],
+                               timeout=5.0)
+            for h in ("aa" * 32, "bb" * 32):
+                sig = bls.sign(
+                    sk, finality_payload(genesis, target, h)).hex()
+                rpc_call(HOST, port0, "sync_vote", [{
+                    "number": target, "hash": h,
+                    "voter": EQUIVOCATOR, "sig": sig,
+                }], timeout=5.0)
+
+            # ---- audit round under fire: register roles, build
+            # fillers, wait for the OCW-driven challenge, prove, verify
+            tee = TeeClient("tee-ctrl", chain_id="chaos", port=port0,
+                            timeout=60.0)
+            stash = TeeClient("tee-stash", chain_id="chaos", port=port0,
+                              timeout=60.0)
+            miner = MinerClient("miner-0", chain_id="chaos", port=port0,
+                                timeout=60.0)
+            stash.submit("staking", "bond", "tee-ctrl", 100_000 * TOKEN)
+            tee.register("tee-stash")
+            wait_for(
+                lambda: rpc_call(HOST, port0, "teeWorker_podr2Key", [],
+                                 timeout=5.0) is not None,
+                90, "tee registration on chain",
+            )
+            miner.register("miner-0-ben", b"peer", 8000 * TOKEN)
+            miner.create_fillers(tee, 2, PARAMS)
+
+            def has_idle_space():
+                try:
+                    return miner.info()["idle_space"] > 0
+                except RpcError:
+                    return False
+
+            wait_for(has_idle_space, 90, "filler report on chain")
+
+            # ---- crash-restart from the SEED's schedule: kill the
+            # chosen victim once its head passes the chosen block,
+            # then relaunch it (it must catch back up under chaos)
+            (victim_idx, at_block), = crash_schedule(CHAOS_SEED, 3)
+            victim = VALIDATORS[victim_idx]
+            wait_for(
+                lambda: status(port0)["number"] >= at_block,
+                120, f"head past crash block {at_block}",
+            )
+            procs[victim].send_signal(signal.SIGKILL)
+            procs[victim].wait(timeout=30)
+            time.sleep(2.0)
+            procs[victim] = launch(
+                spec_path, victim, ports[victim_idx],
+                [p for i, p in enumerate(ports) if i != victim_idx],
+            )
+            wait_rpc(ports[victim_idx])
+
+            def challenged():
+                snap = miner.call("audit_challengeSnapshot")
+                return snap is not None and any(
+                    s["miner"] == "miner-0"
+                    for s in snap["miner_snapshot_list"]
+                )
+
+            wait_for(challenged, 300, "OCW-driven challenge commit")
+
+            from cess_tpu.proof import CpuBackend
+
+            backend = CpuBackend()
+            items = miner.answer_challenge(backend, PARAMS)
+            assert items is not None
+
+            results = wait_for(
+                lambda: tee.verify_missions(
+                    backend, PARAMS, {"miner-0": items}),
+                240, "verify mission assigned",
+            )
+            assert results == {"miner-0": (True, True)}
+            reward = wait_for(
+                lambda: (miner.call("sminer_rewardInfo", "miner-0")
+                         or {}).get("currently_available_reward", 0),
+                180, "audit reward order",
+            )
+            assert reward > 0
+
+            # ---- offences landed on every replica: the equivocator
+            # slashed (5% of its 10k bond to treasury), the silent
+            # node chilled out of the elected set
+            def convicted():
+                for p in ports:
+                    st = rpc_call(HOST, p, "offences_state", [],
+                                  timeout=5.0)
+                    kinds = {
+                        (r["kind"], r["offender"])
+                        for r in st["reports"] if r["applied"]
+                    }
+                    if ("equivocation.vote", EQUIVOCATOR) not in kinds:
+                        return False
+                    if not any(k == "unresponsive" and o == SILENT
+                               for k, o in kinds):
+                        return False
+                return True
+
+            wait_for(convicted, 240, "convictions applied on every node")
+            for p in ports:
+                free = rpc_call(HOST, p, "balances_free",
+                                ["pot/treasury"], timeout=5.0)
+                # the equivocator's 5% slash landed in the treasury
+                # (heavier if chaos produced extra convictions)
+                assert free >= 500 * TOKEN
+                st = rpc_call(HOST, p, "offences_state", [], timeout=5.0)
+                # the chill register shows both convictions bit; the
+                # ACTIVE set is deliberately not asserted — a live
+                # node re-validates once its chill lapses (the
+                # self-healing candidacy path), so membership
+                # oscillates by design for the still-silent node
+                assert EQUIVOCATOR in st["chilledUntil"]
+                assert SILENT in st["chilledUntil"]
+
+            # ---- epoch rotation happened (candidacies → real election)
+            wait_for(
+                lambda: all(
+                    rpc_call(HOST, p, "rrsc_epochInfo", [],
+                             timeout=5.0)["epochIndex"] >= 1
+                    for p in ports
+                ),
+                120, "epoch rotation on every node",
+            )
+
+            # ---- partitions are observable, not silent: the health
+            # view exposes per-peer drop counters (satellite)
+            health = rpc_call(HOST, port0, "system_health", [],
+                              timeout=5.0)
+            assert "gossipDropped" in health
+
+            # ---- convergence: one finalized state hash everywhere
+            fin = wait_for(
+                lambda: min(
+                    status(p)["finalized"]["number"] for p in ports
+                ),
+                180, "finalized head on every node",
+            )
+            assert fin >= 4
+
+            def converged():
+                try:
+                    blocks = [
+                        rpc_call(HOST, p, "sync_block", [fin],
+                                 timeout=5.0)
+                        for p in ports
+                    ]
+                except RpcError:
+                    return None
+                hashes = {b["block"]["stateHash"] for b in blocks}
+                return hashes if len(hashes) == 1 else None
+
+            assert wait_for(converged, 90, "one finalized state hash")
+            miner.close()
+            tee.close()
+            stash.close()
+        finally:
+            for proc in procs.values():
+                if proc.poll() is None:
+                    proc.kill()
+            for proc in procs.values():
+                try:
+                    proc.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    pass
