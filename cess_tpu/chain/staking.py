@@ -170,6 +170,7 @@ class StakingPallet:
         ensure(not self.is_chilled(stash), MOD, "Chilled")
         if stash not in self.candidates:
             self.candidates.append(stash)
+            self.state.deposit_event(MOD, "ValidatorPrefsSet", stash=stash)
 
     def nominate(self, stash: AccountId, targets: list[AccountId]) -> None:
         ensure(stash in self.ledger, MOD, "NotStash")
@@ -178,10 +179,15 @@ class StakingPallet:
             all(t in self.candidates for t in targets), MOD, "BadTarget"
         )
         self.nominations[stash] = list(dict.fromkeys(targets))
+        self.state.deposit_event(
+            MOD, "Nominated", stash=stash,
+            targets=tuple(self.nominations[stash]),
+        )
 
     def chill(self, stash: AccountId) -> None:
         if stash in self.candidates:
             self.candidates.remove(stash)
+            self.state.deposit_event(MOD, "Chilled", stash=stash)
         self.nominations.pop(stash, None)
 
     def is_chilled(self, stash: AccountId) -> bool:

@@ -170,5 +170,17 @@ class ChainState:
             if e.pallet == pallet and (name is None or e.name == name)
         ]
 
+    def event_mark(self) -> int:
+        """Cursor into the append-only sink: take before executing a
+        block, pass to events_since after — the node service files the
+        slice into its per-block ring (chain_getEvents).  Events are
+        deterministic replica-identical telemetry but live OUTSIDE the
+        consensus state hash (chain/checkpoint.py excludes the sink),
+        exactly as the reference keeps events out of the state trie."""
+        return len(self.events)
+
+    def events_since(self, mark: int) -> list[Event]:
+        return list(self.events[mark:])
+
     def clear_events(self) -> None:
         self.events.clear()

@@ -10,6 +10,9 @@ purge-chain) mapped onto this framework's service:
   import-state  start from a checkpoint and print the state hash
   rpc           one-shot JSON-RPC call against a running node
   metrics       fetch a node's Prometheus metrics
+  trace         render a stitched span trace (block #N or trace id),
+                merging spans from several nodes (node/tracing.py)
+  events        fetch one block's deposited events (chain_getEvents)
   bench         run the repo bench (north-star measurement)
 """
 
@@ -144,6 +147,84 @@ def _cmd_metrics(args) -> int:
     return 0
 
 
+def _cmd_trace(args) -> int:
+    from .rpc import RpcError, rpc_call
+    from .tracing import render_trace
+
+    ports = [int(p) for p in str(args.ports).split(",") if p]
+    if args.target is None:
+        # no target: list recent traces from the first REACHABLE node
+        # (a node mid-restart must not crash the listing)
+        for port in ports:
+            try:
+                summary = rpc_call(args.host, port, "system_traces", [])
+            except (OSError, RpcError):
+                continue
+            for t in summary["traces"]:
+                print(
+                    f"{t['traceId']}  {t['root']:<18} "
+                    f"spans={t['spans']:<4} "
+                    f"{t['durationMs']:9.2f}ms  {t['tags']}"
+                )
+            return 0
+        print("no reachable node", file=sys.stderr)
+        return 1
+    # resolve + merge: ask every node for its spans of the trace (a
+    # block number resolves through each node's block→trace map; the
+    # author and importers hold different spans of the SAME trace).
+    # Nodes may resolve a block number to DIFFERENT ids (an envelope
+    # dropped under chaos leaves an importer with a locally minted
+    # id), so spans are grouped per id and the richest trace renders
+    # — mixing two ids under one tree would hide exactly that
+    # divergence.
+    by_tid: dict[str, dict[tuple, dict]] = {}
+    for port in ports:
+        try:
+            got = rpc_call(args.host, port, "system_traces",
+                           [str(args.target)])
+        except (OSError, RpcError):
+            continue
+        for s in got.get("spans", []):
+            by_tid.setdefault(got["traceId"], {})[
+                (s["node"], s["spanId"])] = s
+    if by_tid:
+        # nodes that resolved a block number already returned spans;
+        # every node gets a second chance by each raw id (the author-
+        # minted id is known to importers that adopted it)
+        for trace_id in list(by_tid):
+            for port in ports:
+                try:
+                    got = rpc_call(args.host, port, "system_traces",
+                                   [trace_id])
+                except (OSError, RpcError):
+                    continue
+                for s in got.get("spans", []):
+                    by_tid[trace_id].setdefault(
+                        (s["node"], s["spanId"]), s)
+        best = max(by_tid, key=lambda t: len(by_tid[t]))
+        print(render_trace(list(by_tid[best].values())))
+        others = sorted(set(by_tid) - {best})
+        if others:
+            print(
+                f"note: {len(others)} node(s) hold this block under "
+                f"different trace id(s) {others} — the propagated "
+                "envelope was lost on that path"
+            )
+    else:
+        print(render_trace([]))
+    return 0
+
+
+def _cmd_events(args) -> int:
+    from .rpc import rpc_call
+
+    ref = args.block
+    got = rpc_call(args.host, args.port, "chain_getEvents",
+                   [int(ref) if str(ref).isdigit() else ref])
+    print(json.dumps(got, indent=2, sort_keys=True))
+    return 0
+
+
 def _cmd_bench(_args) -> int:
     import runpy
 
@@ -217,6 +298,23 @@ def build_parser() -> argparse.ArgumentParser:
     met.add_argument("--host", default="127.0.0.1")
     met.add_argument("--port", type=int, default=9944)
     met.set_defaults(fn=_cmd_metrics)
+
+    tr = sub.add_parser(
+        "trace", help="render a stitched span trace across nodes")
+    tr.add_argument("--host", default="127.0.0.1")
+    tr.add_argument("--ports", default="9944",
+                    help="comma-separated RPC ports to merge spans from")
+    tr.add_argument("target", nargs="?", default=None,
+                    help="trace id, block number, or block hash "
+                         "(omit to list recent traces)")
+    tr.set_defaults(fn=_cmd_trace)
+
+    ev = sub.add_parser(
+        "events", help="fetch one block's deposited events")
+    ev.add_argument("--host", default="127.0.0.1")
+    ev.add_argument("--port", type=int, default=9944)
+    ev.add_argument("block", help="block number or hash")
+    ev.set_defaults(fn=_cmd_events)
 
     be = sub.add_parser("bench", help="run the north-star bench")
     be.set_defaults(fn=_cmd_bench)

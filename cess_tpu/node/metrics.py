@@ -5,13 +5,42 @@ The reference threads a prometheus registry through its service
 queue, RPC and telemetry all report into it).  This is the equivalent
 seam: counters/gauges/histograms registered here are rendered in the
 text exposition format by the RPC server's `system_metrics` method and
-the CLI's `metrics` command."""
+the CLI's `metrics` command.  `parse_exposition` is the matching
+reader — the fleet telemetry reporter (tools/telemetry_report.py)
+round-trips `Registry.render()` through it, and the round-trip is a
+test fixture (tests/test_telemetry.py).
+
+Concurrency contract: every read path (samples, render, totals)
+snapshots under the same per-metric lock the write path takes — RPC
+threads scrape while the authoring loop increments, and a torn read
+(e.g. a histogram bucket bumped but `_count` not yet) would render an
+exposition no consistent execution ever produced.
+"""
 
 from __future__ import annotations
 
 import threading
 import time
 from bisect import bisect_right
+
+
+def escape_label_value(value: str) -> str:
+    """Prometheus text-format label escaping: backslash, quote, newline."""
+    return (
+        value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def unescape_label_value(value: str) -> str:
+    out = []
+    it = iter(value)
+    for ch in it:
+        if ch != "\\":
+            out.append(ch)
+            continue
+        nxt = next(it, "")
+        out.append({"n": "\n", '"': '"', "\\": "\\"}.get(nxt, nxt))
+    return "".join(out)
 
 
 class _Metric:
@@ -34,7 +63,8 @@ class Counter(_Metric):
             self.value += amount
 
     def samples(self):
-        return [(self.name, "", self.value)]
+        with self._lock:
+            return [(self.name, "", self.value)]
 
 
 class LabeledCounter(_Metric):
@@ -70,7 +100,7 @@ class LabeledCounter(_Metric):
     def samples(self):
         with self._lock:
             return [
-                (self.name, f'{self.label}="{v}"', n)
+                (self.name, f'{self.label}="{escape_label_value(v)}"', n)
                 for v, n in sorted(self.values.items())
             ]
 
@@ -94,7 +124,8 @@ class Gauge(_Metric):
         self.inc(-amount)
 
     def samples(self):
-        return [(self.name, "", self.value)]
+        with self._lock:
+            return [(self.name, "", self.value)]
 
 
 class Histogram(_Metric):
@@ -132,14 +163,21 @@ class Histogram(_Metric):
         return _Timer()
 
     def samples(self):
+        # snapshot the three correlated fields under the lock: a bucket
+        # bumped by a concurrent observe() with `n` not yet advanced
+        # would render `+Inf` < a finite bucket — a state no execution
+        # ever passed through
+        with self._lock:
+            counts = list(self.counts)
+            total, n = self.total, self.n
         out = []
         acc = 0
-        for b, c in zip(self.buckets, self.counts):
+        for b, c in zip(self.buckets, counts):
             acc += c
             out.append((self.name + "_bucket", f'le="{b}"', acc))
-        out.append((self.name + "_bucket", 'le="+Inf"', self.n))
-        out.append((self.name + "_sum", "", self.total))
-        out.append((self.name + "_count", "", self.n))
+        out.append((self.name + "_bucket", 'le="+Inf"', n))
+        out.append((self.name + "_sum", "", total))
+        out.append((self.name + "_count", "", n))
         return out
 
 
@@ -155,12 +193,20 @@ class Registry:
             self._metrics[metric.name] = metric
 
     def get(self, name: str):
-        return self._metrics.get(name)
+        with self._lock:
+            return self._metrics.get(name)
+
+    def metrics(self) -> list[_Metric]:
+        with self._lock:
+            return list(self._metrics.values())
 
     def render(self) -> str:
-        """Prometheus text exposition format."""
+        """Prometheus text exposition format.  The metric list is
+        snapshotted under the registry lock (register() mutates the
+        dict while RPC scrape threads iterate), and each metric's
+        samples() snapshots under its own lock."""
         lines = []
-        for m in self._metrics.values():
+        for m in self.metrics():
             if m.help:
                 lines.append(f"# HELP {m.name} {m.help}")
             lines.append(f"# TYPE {m.name} {m.kind}")
@@ -177,3 +223,120 @@ REGISTRY = Registry()
 def scoped_registry() -> Registry:
     """Fresh registry for tests / multiple in-process services."""
     return Registry()
+
+
+def render_merged(*registries: Registry) -> str:
+    """Concatenated exposition of several registries (the node's RPC
+    merges its per-service registry with the process-wide proof-stage
+    registry, proof/xla_backend.py)."""
+    return "".join(r.render() for r in registries)
+
+
+# ---------------------------------------------------------- exposition io
+
+
+class MetricFamily:
+    """Parsed exposition family: name, kind, help, and samples as
+    (suffixed_name, labels_dict, value) triples."""
+
+    def __init__(self, name: str, kind: str = "untyped", help_: str = ""):
+        self.name = name
+        self.kind = kind
+        self.help = help_
+        self.samples: list[tuple[str, dict[str, str], float]] = []
+
+    def value(self, default: float = 0.0) -> float:
+        """The single unlabelled sample (counters/gauges)."""
+        for sname, labels, v in self.samples:
+            if sname == self.name and not labels:
+                return v
+        return default
+
+    def total(self) -> float:
+        """Sum over every sample of the base name (labelled counters)."""
+        return sum(v for sname, _, v in self.samples if sname == self.name)
+
+    def histogram(self) -> dict:
+        """{buckets: [(le, cumulative)], sum, count} for histogram kind."""
+        buckets, total, count = [], 0.0, 0.0
+        for sname, labels, v in self.samples:
+            if sname == self.name + "_bucket":
+                le = labels.get("le", "+Inf")
+                buckets.append(
+                    (float("inf") if le == "+Inf" else float(le), v)
+                )
+            elif sname == self.name + "_sum":
+                total = v
+            elif sname == self.name + "_count":
+                count = v
+        buckets.sort(key=lambda b: b[0])
+        return {"buckets": buckets, "sum": total, "count": count}
+
+
+def _parse_labels(raw: str) -> dict[str, str]:
+    labels: dict[str, str] = {}
+    i = 0
+    while i < len(raw):
+        eq = raw.index("=", i)
+        key = raw[i:eq].strip().strip(",")
+        assert raw[eq + 1] == '"', f"unquoted label value in {raw!r}"
+        j = eq + 2
+        buf = []
+        while raw[j] != '"':
+            if raw[j] == "\\":
+                buf.append(raw[j:j + 2])
+                j += 2
+            else:
+                buf.append(raw[j])
+                j += 1
+        labels[key] = unescape_label_value("".join(buf))
+        i = j + 1
+    return labels
+
+
+def parse_exposition(text: str) -> dict[str, MetricFamily]:
+    """Parse the Prometheus text format `Registry.render()` emits back
+    into metric families — the scrape side of the telemetry reporter.
+    Histogram `_bucket`/`_sum`/`_count` samples group under their base
+    family name."""
+    families: dict[str, MetricFamily] = {}
+
+    def family_of(sample_name: str) -> MetricFamily:
+        base = sample_name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if sample_name.endswith(suffix):
+                cand = sample_name[: -len(suffix)]
+                if cand in families and families[cand].kind == "histogram":
+                    base = cand
+                    break
+        if base not in families:
+            families[base] = MetricFamily(base)
+        return families[base]
+
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, _, help_ = rest.partition(" ")
+            families.setdefault(name, MetricFamily(name)).help = help_
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, kind = rest.partition(" ")
+            fam = families.setdefault(name, MetricFamily(name))
+            fam.kind = kind.strip()
+            continue
+        if line.startswith("#"):
+            continue
+        if "{" in line:
+            name = line[: line.index("{")]
+            raw = line[line.index("{") + 1: line.rindex("}")]
+            value = float(line[line.rindex("}") + 1:].strip())
+            labels = _parse_labels(raw)
+        else:
+            name, _, v = line.rpartition(" ")
+            labels, value = {}, float(v)
+        family_of(name).samples.append((name, labels, value))
+    return families

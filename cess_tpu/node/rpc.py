@@ -73,11 +73,29 @@ class RpcApi:
 
         @method("system_health")
         def _health():
+            with s._lock:
+                best = s.rt.state.block_number
+                finalized = s.finalized_number
             return {
                 "peers": len(s.sync.peers) if s.sync is not None else 0,
                 "isSyncing": False,
                 "shouldHavePeers": len(s.spec.validators) > 1,
                 "txpool": len(s.pool),
+                "txPoolSize": len(s.pool),
+                "bestBlock": best,
+                # finality lag: the observable the GRANDPA
+                # accountable-safety drills need (PAPERS.md) — a node
+                # whose lag grows while bestBlock advances is cut off
+                # from the voter set even if gossip drops stay quiet
+                "finalityLag": best - finalized,
+                "finalizedBlock": finalized,
+                # per-peer freshness: epoch seconds of the last
+                # successful round-trip — a partitioned node's peers go
+                # STALE here (drop counters only move once queues
+                # overflow, which a silent partition never does)
+                "peersSeen": (
+                    s.sync.peers_seen() if s.sync is not None else {}
+                ),
                 # per-peer gossip overflow drops (node/sync.py): a
                 # partitioned or hung peer shows up here instead of
                 # dropping silently
@@ -88,7 +106,39 @@ class RpcApi:
 
         @method("system_metrics")
         def _metrics():
-            return s.registry.render()
+            # merged exposition: this service's registry + the
+            # process-wide proof-stage registry (proof/xla_backend.py
+            # observes its per-stage histograms there — always on)
+            from ..proof.xla_backend import proof_stage_registry
+            from . import metrics as _m
+
+            return _m.render_merged(s.registry, proof_stage_registry())
+
+        @method("system_traces")
+        def _traces(trace_id: str | None = None, limit: int = 32):
+            """Span-tree telemetry (node/tracing.py).  Without an id:
+            recent trace summaries.  With one: every span this node
+            recorded for it — the CLI `trace` command merges these
+            across nodes into one stitched tree.  A block number or
+            hash also resolves (via the block→trace map), so `where
+            did block #N spend its time?` is one call."""
+            tid = trace_id
+            if tid is not None:
+                tid = str(tid)
+                with s._lock:
+                    if tid.isdigit():
+                        blk = s.block_by_number.get(int(tid))
+                        if blk is not None:
+                            tid = s.block_traces.get(
+                                blk.hash(s.genesis), tid)
+                    elif tid in s.block_traces:
+                        tid = s.block_traces[tid]
+                spans = s.tracer.spans(trace_id=tid)
+                return {
+                    "traceId": tid,
+                    "spans": [sp.to_json() for sp in spans],
+                }
+            return {"traces": s.tracer.traces(limit=int(limit))}
 
         @method("system_chainGenesis")
         def _genesis():
@@ -117,6 +167,26 @@ class RpcApi:
         @method("state_getEvents")
         def _events(last: int = 20):
             return _view(list(s.rt.state.events)[-int(last):])
+
+        @method("chain_getEvents")
+        def _block_events(block_ref):
+            """Deposited events of ONE block (hash or number), with the
+            digest of their canonical encoding — the lockstep tests
+            assert this is bit-identical on every replica."""
+            entry = s.events_of_block(block_ref)
+            if entry is None:
+                raise RpcError(-32004, "block events not held")
+            bh, number, events, digest = entry
+            return {
+                "blockHash": bh,
+                "number": number,
+                "digest": digest,
+                "events": [
+                    {"pallet": e.pallet, "name": e.name,
+                     "fields": _view(dict(e.fields))}
+                    for e in events
+                ],
+            }
 
         # ---- author
         @method("author_submitExtrinsic")
@@ -299,9 +369,9 @@ class RpcApi:
             }
 
         @method("sync_announce")
-        def _sync_announce(block: dict):
+        def _sync_announce(block: dict, trace=None):
             try:
-                return s.handle_announce(block)
+                return s.handle_announce(block, trace=trace)
             except BlockImportError as e:
                 raise RpcError(-32020, str(e))
 
@@ -314,6 +384,9 @@ class RpcApi:
             return {
                 "block": blk.to_json(),
                 "justification": None if just is None else just.to_json(),
+                # trace-id envelope (telemetry): lets a catch-up
+                # importer stitch its spans onto the author's trace
+                "trace": s.block_traces.get(blk.hash(s.genesis)),
             }
 
         @method("sync_block_range")
@@ -336,6 +409,7 @@ class RpcApi:
                     "justification": (
                         None if just is None else just.to_json()
                     ),
+                    "trace": s.block_traces.get(blk.hash(s.genesis)),
                 })
             return out
 

@@ -49,6 +49,7 @@ from .sync import (
     verify_justification,
 )
 from . import metrics as m
+from . import tracing
 
 
 # ------------------------------------------------------------ extrinsic
@@ -354,6 +355,19 @@ class BlockRecord:
 # of duplicating the number.
 STATE_CACHE_BLOCKS = 64
 
+# Per-block deposited-event ring (chain_getEvents) and block→trace-id
+# map: both are telemetry bookkeeping, bounded independently of the
+# state-blob cache so observability reaches further back than reorg
+# depth without holding full state blobs.
+EVENT_RING_BLOCKS = 256
+TRACE_MAP_BLOCKS = 512
+
+# Cumulative deposited-event sink bound: the in-block sink
+# (ChainState.events) stays append-only so direct-runtime callers see
+# history, but a long-running node trims the oldest half past this —
+# the per-block ring above is the durable per-block record.
+EVENT_SINK_MAX = 50_000
+
 
 class NodeService:
     """One chain node: Runtime + pool + block authoring + state export.
@@ -423,6 +437,19 @@ class NodeService:
         self.block_by_number: dict[int, Block] = {}
         self._state_blobs: OrderedDict[str, bytes] = OrderedDict()
         self._state_blobs[self.genesis] = checkpoint.snapshot(self.rt)
+
+        # Observability (node/tracing.py + the per-block event ring):
+        # the tracer collects span trees; block_traces maps block hash →
+        # trace id so finality/justification spans stitch into the
+        # block's trace, including ids adopted from peer envelopes;
+        # events_by_block holds each block's deposited events (drained
+        # from the runtime sink at commit — the chain_getEvents feed,
+        # deterministic and bit-identical across replicas but OUTSIDE
+        # the consensus state hash).
+        self.tracer = tracing.Tracer(node=authority or "dev")
+        self.block_traces: OrderedDict[str, str] = OrderedDict()
+        self.events_by_block: OrderedDict[str, tuple[int, list]] = (
+            OrderedDict())
 
         # Finality (node/sync.py GRANDPA stand-in): collected votes per
         # (number, hash), targets this node already voted, and accepted
@@ -495,6 +522,28 @@ class NodeService:
         self.m_offences = m.Counter(
             "cess_offences_reported",
             "offence reports this node built or relayed", reg)
+        # Import-stage histograms (the per-stage timing the tracing
+        # spans record, aggregated for the fleet reporter): signature
+        # batch, deterministic re-execution, post-state snapshot.
+        stage_buckets = (0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5,
+                         1.0, 2.5, 5.0)
+        self.m_import_stage = {
+            stage: m.Histogram(
+                f"cess_import_{stage}_seconds",
+                f"block import {label} time",
+                buckets=stage_buckets, registry=reg)
+            for stage, label in (
+                ("sig_batch", "signature batch verification"),
+                ("execute", "deterministic re-execution"),
+                ("snapshot", "post-state snapshot + hash"),
+            )
+        }
+        self.m_finality_lag = m.Gauge(
+            "cess_finality_lag_blocks",
+            "best block minus finalized block", reg)
+        self.m_events = m.Counter(
+            "cess_events_deposited",
+            "runtime events deposited by committed blocks", reg)
         self.registry = reg
 
     # ------------------------------------------------------ submission
@@ -514,18 +563,30 @@ class NodeService:
         pk = self.keys.get(ext.signer)
         if pk is None:
             raise ValueError(f"unknown signer {ext.signer}")
-        if not _verified and not bls.verify(
-            pk, ext.payload(self.genesis), bytes.fromhex(ext.signature)
-        ):
-            raise ValueError("bad signature")
-        # nonce check-and-increment under the service lock: concurrent
-        # RPC threads must not both pass with the same nonce
-        with self._lock:
-            expected = self.nonces.get(ext.signer, 0)
-            if ext.nonce != expected:
-                raise ValueError(f"bad nonce: expected {expected}")
-            self.nonces[ext.signer] = expected + 1
-            h = self.pool.submit(ext, self.genesis)
+        # Extrinsic intake mints a trace (the other trace root next to
+        # block authorship): the span records validation cost and the
+        # verdict, queryable via system_traces.
+        with self.tracer.span(
+            "extrinsic.intake", trace=tracing.mint_trace_id(),
+            tags={"module": ext.module, "call": ext.call,
+                  "signer": ext.signer},
+        ) as span:
+            if not _verified and not bls.verify(
+                pk, ext.payload(self.genesis), bytes.fromhex(ext.signature)
+            ):
+                span.tags["rejected"] = "bad-signature"
+                raise ValueError("bad signature")
+            # nonce check-and-increment under the service lock:
+            # concurrent RPC threads must not both pass with the
+            # same nonce
+            with self._lock:
+                expected = self.nonces.get(ext.signer, 0)
+                if ext.nonce != expected:
+                    span.tags["rejected"] = "bad-nonce"
+                    raise ValueError(f"bad nonce: expected {expected}")
+                self.nonces[ext.signer] = expected + 1
+                h = self.pool.submit(ext, self.genesis)
+            span.tags["hash"] = h[:16]
         self.m_pool.set(len(self.pool))
         if gossip and self.sync is not None:
             self.sync.broadcast_extrinsic(ext)
@@ -604,10 +665,13 @@ class NodeService:
         return None
 
     def _commit_block(
-        self, block: Block, record: BlockRecord, blob: bytes
+        self, block: Block, record: BlockRecord, blob: bytes,
+        events: list | None = None, trace: str | None = None,
     ) -> None:
         """Head bookkeeping after a block executed: store, cache the
-        post-state blob, advance the head anchor and slot clock."""
+        post-state blob, advance the head anchor and slot clock, file
+        the block's deposited events into the per-block ring and pin
+        its trace id."""
         h = block.hash(self.genesis)
         record.hash = h
         self.block_store[h] = block
@@ -617,8 +681,24 @@ class NodeService:
         self._state_blobs[h] = blob
         while len(self._state_blobs) > STATE_CACHE_BLOCKS:
             self._state_blobs.popitem(last=False)
+        if events is not None:
+            self.events_by_block[h] = (block.number, list(events))
+            self.m_events.inc(len(events))
+            while len(self.events_by_block) > EVENT_RING_BLOCKS:
+                self.events_by_block.popitem(last=False)
+        if trace is not None:
+            self.block_traces[h] = trace
+            while len(self.block_traces) > TRACE_MAP_BLOCKS:
+                self.block_traces.popitem(last=False)
+        # bound the cumulative runtime sink (the per-block ring above
+        # is the durable record; direct-runtime callers keep history
+        # up to the trim threshold)
+        sink = self.rt.state.events
+        if len(sink) > EVENT_SINK_MAX:
+            del sink[: len(sink) - EVENT_SINK_MAX // 2]
         self.blocks.append(record)
         self.m_pool.set(len(self.pool))
+        self.m_finality_lag.set(block.number - self.finalized_number)
 
     def produce_block(self, slot: int | None = None) -> BlockRecord | None:
         """One slot: on_initialize hooks, then apply pooled extrinsics.
@@ -652,37 +732,63 @@ class NodeService:
             sk = self._author_sk(author)
             if sk is None:
                 return None
+            # The claim is evaluated BEFORE any span opens: most slots
+            # are not ours on a multi-validator chain, and recording a
+            # root span per unclaimed slot would evict real block
+            # traces from the bounded ring.  The claim's cost is
+            # back-dated into the trace as a point event once we know
+            # the slot is won.
+            t_claim = time.perf_counter()
             claim = consensus.claim_slot(
                 self.rt.rrsc, self.genesis, author, sk, self.slot)
+            claim_s = time.perf_counter() - t_claim
             if claim is None:
                 return None  # neither primary nor secondary this slot
-            parent = self.head_hash
-            slot = self.slot
-            exts = self.pool.drain(self.MAX_EXTRINSICS_PER_BLOCK)
-            # the output is consensus state the moment the block exists:
-            # fold BEFORE run_blocks, so an era rotation inside this very
-            # block already accumulates it (importers do the same)
-            self.rt.rrsc.fold_vrf_output(slot, claim.output)
-            self.rt.run_blocks(1)
-            record = BlockRecord(
-                number=self.rt.state.block_number, author=author)
-            self._apply_extrinsics(exts, record)
-            blob, shash = checkpoint.snapshot_and_hash(self.rt)
-            block = Block(
-                number=record.number, slot=slot, parent=parent,
-                author=author, state_hash=shash,
-                extrinsics=[e.to_json() for e in exts],
-                vrf_output=claim.output.hex(),
-                vrf_proof=claim.proof.hex(),
-            )
-            block.sign(sk, self.genesis)
-            self._commit_block(block, record, blob)
-            self.m_blocks.inc()
-            (self.m_vrf_primary if claim.primary
-             else self.m_vrf_secondary).inc()
+            # Trace root minted HERE — block authorship is where a
+            # block's life begins; the id rides the announce envelope
+            # so importers stitch their spans onto this trace.
+            tid = tracing.mint_trace_id()
+            with self.tracer.span(
+                "block.author", trace=tid,
+                tags={"slot": self.slot, "author": author},
+            ) as root:
+                self.tracer.event("author.claim", duration=claim_s)
+                parent = self.head_hash
+                slot = self.slot
+                exts = self.pool.drain(self.MAX_EXTRINSICS_PER_BLOCK)
+                ev_base = self.rt.state.event_mark()
+                # the output is consensus state the moment the block
+                # exists: fold BEFORE run_blocks, so an era rotation
+                # inside this very block already accumulates it
+                # (importers do the same)
+                with self.tracer.span(
+                    "author.execute", tags={"extrinsics": len(exts)}
+                ):
+                    self.rt.rrsc.fold_vrf_output(slot, claim.output)
+                    self.rt.run_blocks(1)
+                    record = BlockRecord(
+                        number=self.rt.state.block_number, author=author)
+                    self._apply_extrinsics(exts, record)
+                with self.tracer.span("author.snapshot"):
+                    blob, shash = checkpoint.snapshot_and_hash(self.rt)
+                events = self.rt.state.events_since(ev_base)
+                block = Block(
+                    number=record.number, slot=slot, parent=parent,
+                    author=author, state_hash=shash,
+                    extrinsics=[e.to_json() for e in exts],
+                    vrf_output=claim.output.hex(),
+                    vrf_proof=claim.proof.hex(),
+                )
+                block.sign(sk, self.genesis)
+                root.tags["number"] = record.number
+                self._commit_block(block, record, blob,
+                                   events=events, trace=tid)
+                self.m_blocks.inc()
+                (self.m_vrf_primary if claim.primary
+                 else self.m_vrf_secondary).inc()
         # outside the lock: network fan-out + offchain hooks
         if self.sync is not None:
-            self.sync.announce_block(block)
+            self.sync.announce_block(block, trace=tid)
         self._post_block(record.number)
         return record
 
@@ -714,7 +820,9 @@ class NodeService:
             self.pool.requeue(exts, self.genesis)
             self.m_pool.set(len(self.pool))
 
-    def _rollback_head(self) -> tuple[Block, str, bytes, BlockRecord | None]:
+    def _rollback_head(
+        self,
+    ) -> tuple[Block, str, bytes, BlockRecord | None, list | None]:
         """Drop the current head (same-height fork choice lost): restore
         the parent post-state blob and rewind bookkeeping.  Pool nonces
         are left at their high-water mark — intake gating is node-local,
@@ -734,6 +842,11 @@ class NodeService:
         record = None
         if self.blocks and self.blocks[-1].number == head.number:
             record = self.blocks.pop()
+        # retract the head's events: drop its ring entry and (when the
+        # sink tail still ends with exactly those events — checkpoint
+        # restore no longer rewinds the sink) truncate the sink, so a
+        # replica that never saw the losing block reads the same ring
+        head_events = self._retract_events(head_hash)
         checkpoint.restore(self.rt, parent_blob)
         self.head_hash = head.parent
         # NOTE: _voted deliberately keeps the retracted height.  A vote
@@ -744,11 +857,25 @@ class NodeService:
         # possibly-lapsed boundary; the next period finalizes normally.
         self._requeue_retracted([head])
         self.m_reorgs.inc()
-        return head, head_hash, head_blob, record
+        return head, head_hash, head_blob, record, head_events
+
+    def _retract_events(self, block_hash: str) -> list | None:
+        """Drop a retracted block's ring entry and rewind the runtime
+        sink if its tail is still exactly that block's events (the
+        sink is append-only; checkpoint blobs no longer carry it)."""
+        entry = self.events_by_block.pop(block_hash, None)
+        if entry is None:
+            return None
+        _, events = entry
+        sink = self.rt.state.events
+        n = len(events)
+        if n and len(sink) >= n and sink[-n:] == events:
+            del sink[-n:]
+        return events
 
     def _reinstate_head(
         self, head: Block, head_hash: str, head_blob: bytes,
-        record: BlockRecord | None,
+        record: BlockRecord | None, head_events: list | None,
     ) -> None:
         """Undo a _rollback_head after the competing block failed
         verification: restore the old head's state and bookkeeping and
@@ -758,12 +885,16 @@ class NodeService:
         self.block_by_number[head.number] = head
         self._state_blobs[head_hash] = head_blob
         self.head_hash = head_hash
+        if head_events is not None:
+            self.events_by_block[head_hash] = (head.number, head_events)
+            self.rt.state.events.extend(head_events)
         if record is not None:
             self.blocks.append(record)
             self.pool.prune(set(record.extrinsics), self.genesis)
 
     def import_block(
-        self, block: Block, sigs_verified: bool = False
+        self, block: Block, sigs_verified: bool = False,
+        trace: str | None = None, origin: str = "announce",
     ) -> BlockRecord | None:
         """Verify and re-execute a peer block (the import-queue role).
 
@@ -787,12 +918,36 @@ class NodeService:
         exactly once.  `sigs_verified=True` (the range-batch catch-up
         path, node/sync.py) skips the pairing work — the caller
         already verified every signature in one weighted batch — but
-        every structural and state check still runs."""
-        try:
-            return self._import_block_inner(block, sigs_verified)
-        except BlockImportError:
-            self.m_import_rejected.inc()
-            raise
+        every structural and state check still runs.
+
+        `trace` is the author-minted trace id from the gossip/catch-up
+        envelope (node/tracing.py): the import spans recorded here join
+        the author's trace, so `system_traces` shows one stitched tree
+        for the block's whole life.  Telemetry only — an absent or
+        garbled id mints a local one and affects nothing else."""
+        # Pin the trace id EXPLICITLY: a missing/garbled envelope id
+        # mints a fresh per-block trace rather than falling back to
+        # span-stack inheritance — inside a catchup.range span, N
+        # envelope-less blocks would otherwise all share the range's
+        # trace id and render as one merged tree.
+        with self.tracer.span(
+            "block.import",
+            trace=(trace if tracing.valid_trace_id(trace)
+                   else tracing.mint_trace_id()),
+            tags={"number": block.number, "author": block.author,
+                  "origin": origin},
+        ) as root:
+            try:
+                rec = self._import_block_inner(block, sigs_verified)
+            except BlockImportError as e:
+                root.tags["rejected"] = str(e)
+                self.m_import_rejected.inc()
+                raise
+            if rec is None:
+                # known/stale/ignored: _commit_block (which pins the
+                # adopted trace id into block_traces) never ran
+                root.tags["outcome"] = "known-or-ignored"
+            return rec
 
     def _claim_rank(self, block: Block) -> int:
         """Fork-choice rank of a block's slot claim (0 primary, 1
@@ -890,7 +1045,8 @@ class NodeService:
                 # under that validator's key.  (Skipped when the block-
                 # equivocation probe above already paid this pairing.)
                 if not author_checked:
-                    self._check_author_signature(block)
+                    with self.tracer.span("import.fork_choice_auth"):
+                        self._check_author_signature(block)
                 undo = self._rollback_head()
                 head_n -= 1
             author_verified = undo is not None
@@ -910,7 +1066,9 @@ class NodeService:
                 if undo is not None:
                     self._reinstate_head(*undo)
                 raise
-            self._commit_block(block, record[0], record[1])
+            self._commit_block(
+                block, record[0], record[1], events=record[2],
+                trace=self.tracer.current_trace())
             self.m_imported.inc()
         self._post_block(block.number)
         return record[0]
@@ -940,7 +1098,7 @@ class NodeService:
     def _verify_and_apply(
         self, block: Block, author_verified: bool = False,
         sigs_verified: bool = False,
-    ) -> tuple[BlockRecord, bytes]:
+    ) -> tuple[BlockRecord, bytes, list]:
         """Slot-claim check + signature batch + deterministic
         re-execution; rolls the runtime back on a post-state mismatch.
         Caller holds the lock, runtime is at the parent state.
@@ -991,42 +1149,58 @@ class NodeService:
                 triples.append((epk, payload, bytes.fromhex(ext.signature)))
             except ValueError:
                 raise BlockImportError("undecodable signature")
-        if not sigs_verified and not bls_agg.verify_batch_host(
-            triples, seed=self.genesis.encode()
-        ):
-            raise BlockImportError("bad block/extrinsic/vrf signature")
+        if not sigs_verified:
+            with self.tracer.span(
+                "import.sig_batch", tags={"sigs": len(triples)}
+            ), self.m_import_stage["sig_batch"].time():
+                ok = bls_agg.verify_batch_host(
+                    triples, seed=self.genesis.encode())
+            if not ok:
+                raise BlockImportError("bad block/extrinsic/vrf signature")
 
         pre_blob = self._state_blobs.get(self.head_hash)
+        ev_base = self.rt.state.event_mark()
         # the verified output becomes consensus state before the block
         # executes — mirror of produce_block's fold order
-        self.rt.rrsc.fold_vrf_output(
-            block.slot, bytes.fromhex(block.vrf_output))
-        self.rt.run_blocks(1)
-        record = BlockRecord(
-            number=self.rt.state.block_number, author=block.author,
-            imported=True)
-        self._apply_extrinsics(exts, record)
-        blob, shash = checkpoint.snapshot_and_hash(self.rt)
+        with self.tracer.span(
+            "import.execute", tags={"extrinsics": len(exts)}
+        ), self.m_import_stage["execute"].time():
+            self.rt.rrsc.fold_vrf_output(
+                block.slot, bytes.fromhex(block.vrf_output))
+            self.rt.run_blocks(1)
+            record = BlockRecord(
+                number=self.rt.state.block_number, author=block.author,
+                imported=True)
+            self._apply_extrinsics(exts, record)
+        with self.tracer.span("import.snapshot"), \
+                self.m_import_stage["snapshot"].time():
+            blob, shash = checkpoint.snapshot_and_hash(self.rt)
         if shash != block.state_hash:
+            # rewind the event sink too: checkpoint blobs no longer
+            # carry events, so the restore below cannot do it
+            del self.rt.state.events[ev_base:]
             if pre_blob is not None:
                 checkpoint.restore(self.rt, pre_blob)
             raise BlockImportError("post-state hash mismatch")
+        events = self.rt.state.events_since(ev_base)
         # advance intake nonces so local submissions stay in step,
         # and drop now-included extrinsics from our own pool
         for ext in exts:
             cur = self.nonces.get(ext.signer, 0)
             self.nonces[ext.signer] = max(cur, ext.nonce + 1)
         self.pool.prune(set(record.extrinsics), self.genesis)
-        return record, blob
+        return record, blob, events
 
-    def handle_announce(self, block_json: dict) -> str:
-        """`sync_announce` intake: import, or catch up on a gap."""
+    def handle_announce(self, block_json: dict,
+                        trace: str | None = None) -> str:
+        """`sync_announce` intake: import, or catch up on a gap.
+        `trace` is the author's trace-id envelope (telemetry only)."""
         try:
             block = Block.from_json(block_json)
         except (KeyError, TypeError, ValueError) as e:
             raise BlockImportError(f"malformed block: {e!r}")
         try:
-            rec = self.import_block(block)
+            rec = self.import_block(block, trace=trace, origin="gossip")
         except SyncGap:
             if self.sync is not None:
                 self.sync.catch_up()
@@ -1065,18 +1239,22 @@ class NodeService:
                 return False
             checkpoint.restore(self.rt, blob)
             retracted = []
-            for n in range(ancestor_number + 1, head_n + 1):
+            for n in range(head_n, ancestor_number, -1):
+                # newest first, so the event-sink tail rewinds block by
+                # block (each retraction strips its own events tail)
                 blk = self.block_by_number.pop(n, None)
                 if blk is not None:
                     retracted.append(blk)
                     bh = blk.hash(self.genesis)
                     self.block_store.pop(bh, None)
                     self._state_blobs.pop(bh, None)
+                    self._retract_events(bh)
             while self.blocks and self.blocks[-1].number > ancestor_number:
                 self.blocks.pop()
             self.head_hash = anchor
             # _voted keeps retracted heights on purpose: re-voting a
             # replaced hash is equivocation (see _rollback_head)
+            retracted.reverse()  # requeue oldest-first: nonce order
             self._requeue_retracted(retracted)
             self.m_reorgs.inc()
             return True
@@ -1152,11 +1330,20 @@ class NodeService:
             seen = self._votes.get((vote.number, vote.block_hash))
             if seen is not None and vote.voter in seen:
                 return True
-        if not _trusted and not bls.verify(
-            pk, finality_payload(self.genesis, vote.number, vote.block_hash),
-            bytes.fromhex(vote.signature),
-        ):
-            return False
+        if not _trusted:
+            with self.tracer.span(
+                "finality.vote_verify",
+                trace=self.block_traces.get(vote.block_hash),
+                tags={"voter": vote.voter, "number": vote.number},
+            ):
+                ok = bls.verify(
+                    pk,
+                    finality_payload(
+                        self.genesis, vote.number, vote.block_hash),
+                    bytes.fromhex(vote.signature),
+                )
+            if not ok:
+                return False
         just = None
         offence = None
         with self._lock:
@@ -1197,6 +1384,12 @@ class NodeService:
                 self._vote_hash.setdefault(
                     vote.number, {})[vote.voter] = vote.block_hash
                 self.m_votes.inc()
+                self.tracer.event(
+                    "finality.vote",
+                    trace=self.block_traces.get(vote.block_hash),
+                    tags={"voter": vote.voter, "number": vote.number,
+                          "tally": len(tally)},
+                )
                 if quorum(len(tally), len(validators)):
                     just = Justification.from_votes(
                         vote.number, vote.block_hash, tally)
@@ -1225,10 +1418,17 @@ class NodeService:
         with self._lock:
             if just.number <= self.finalized_number:
                 return False
-        if not _verified and not verify_justification(
-            just, self.genesis, self.spec.validators, self.keys
-        ):
-            return False
+        if not _verified:
+            with self.tracer.span(
+                "finality.just_verify",
+                trace=self.block_traces.get(just.block_hash),
+                tags={"number": just.number,
+                      "signers": len(just.signers)},
+            ):
+                ok = verify_justification(
+                    just, self.genesis, self.spec.validators, self.keys)
+            if not ok:
+                return False
         with self._lock:
             if just.number <= self.finalized_number:
                 return False
@@ -1250,6 +1450,14 @@ class NodeService:
             self.finalized_hash = just.block_hash
             self.justifications[just.number] = just
             self.m_finalized.set(just.number)
+            self.m_finality_lag.set(
+                self.rt.state.block_number - just.number)
+            self.tracer.event(
+                "finality.finalized",
+                trace=self.block_traces.get(just.block_hash),
+                tags={"number": just.number,
+                      "signers": len(just.signers)},
+            )
             self._votes = {
                 k: v for k, v in self._votes.items()
                 if k[0] > just.number
@@ -1460,7 +1668,12 @@ class NodeService:
                 except ValueError:
                     pass
 
-            self.rt.audit.offchain_worker(now, ident, submit=submit)
+            with self.tracer.span(
+                "ocw.audit",
+                trace=self.block_traces.get(self.head_hash),
+                tags={"block": now, "authority": ident},
+            ):
+                self.rt.audit.offchain_worker(now, ident, submit=submit)
 
     # ------------------------------------------------------ slot loop
 
@@ -1532,6 +1745,11 @@ class NodeService:
         self.block_by_number.clear()
         self.blocks.clear()
         self._state_blobs.clear()
+        # pre-restore history is gone: the event ring and the runtime
+        # sink restart with the restored chain (events are per-block
+        # telemetry, never part of a checkpoint blob)
+        self.events_by_block.clear()
+        self.rt.state.events.clear()
         self.head_hash = anchor_hash
         if head is not None:
             self.block_store[anchor_hash] = head
@@ -1613,3 +1831,26 @@ class NodeService:
     def state_hash(self) -> str:
         with self._lock:
             return checkpoint.state_hash(self.rt)
+
+    def events_of_block(self, block_ref) -> tuple | None:
+        """Per-block deposited events (`chain_getEvents` feed): accepts
+        a block hash or number; returns (hash, number, events, digest)
+        with the digest over the canonical event encoding
+        (chain/checkpoint.py events_digest) — replicas that executed
+        the block identically serve bit-identical lists."""
+        with self._lock:
+            if isinstance(block_ref, int) or (
+                isinstance(block_ref, str) and block_ref.isdigit()
+            ):
+                blk = self.block_by_number.get(int(block_ref))
+                if blk is None:
+                    return None
+                bh = blk.hash(self.genesis)
+            else:
+                bh = str(block_ref)
+            entry = self.events_by_block.get(bh)
+            if entry is None:
+                return None
+            number, events = entry
+            events = list(events)
+        return bh, number, events, checkpoint.events_digest(events)

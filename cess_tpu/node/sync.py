@@ -62,8 +62,13 @@ def _rpc_errors() -> tuple[type, ...]:
 # v2: headers carry the BLS-VRF slot claim (vrfOut/vrfProof —
 # cess_tpu/consensus).  v3: session/offences pallets joined the
 # replicated state (chain/{session,offences}.py) — a v2 peer would
-# re-execute our blocks to a different state hash.
-SYNC_PROTO_VERSION = 3
+# re-execute our blocks to a different state hash.  v4: the deposited-
+# event sink left the consensus state hash (chain/checkpoint.py v5 —
+# events are per-block telemetry now), so a v3 peer computes different
+# state hashes for identical chains; announce/catch-up envelopes also
+# carry optional trace ids (node/tracing.py — telemetry, ignored by
+# verification).
+SYNC_PROTO_VERSION = 4
 
 # Peer-gossip socket timeout: announcements are fire-and-forget, a dead
 # peer must not stall the authoring loop.
@@ -356,6 +361,11 @@ class SyncManager:
         }
         self._queue_lock = threading.Lock()
         self._queued = {peer: 0 for peer in self.peers}
+        # Last successful round-trip per peer (gossip ack or catch-up
+        # reply), epoch seconds: the system_health `peersSeen`
+        # freshness feed — a partitioned node's peers go stale here
+        # even when its drop counters are still quiet.
+        self._peer_seen: dict[str, float] = {}
         service.attach_sync(self)
 
     def stop(self) -> None:
@@ -369,6 +379,16 @@ class SyncManager:
     def _peer_label(peer) -> str:
         return f"{peer[0]}:{peer[1]}"
 
+    def _mark_peer_seen(self, peer) -> None:
+        with self._queue_lock:
+            self._peer_seen[self._peer_label(peer)] = time.time()
+
+    def peers_seen(self) -> dict[str, float]:
+        """peer → epoch seconds of the last successful round-trip
+        (system_health freshness view)."""
+        with self._queue_lock:
+            return dict(self._peer_seen)
+
     def _cast(self, method: str, params: list) -> None:
         """Fire-and-forget to every peer via its ordered gossip queue:
         the authoring loop must never block on a peer's import time
@@ -379,6 +399,8 @@ class SyncManager:
         drop, delay, duplicate, or reorder each message."""
 
         def one(peer, delay, msg):
+            from .rpc import RpcError
+
             try:
                 if delay:
                     # injected link latency: sleeping in the peer's own
@@ -386,6 +408,12 @@ class SyncManager:
                     # exactly like a slow real link
                     time.sleep(delay)
                 _rpc(*peer, msg[0], msg[1], GOSSIP_TIMEOUT_S)
+                self._mark_peer_seen(peer)
+            except RpcError:
+                # the peer ANSWERED (rejected the message): that is a
+                # completed round-trip for freshness purposes — only
+                # socket-level failures leave peersSeen stale
+                self._mark_peer_seen(peer)
             except _rpc_errors():
                 pass
             finally:
@@ -419,8 +447,11 @@ class SyncManager:
         health view's partition-visibility feed)."""
         return self.m_gossip_dropped.counts()
 
-    def announce_block(self, block: Block) -> None:
-        self._cast("sync_announce", [block.to_json()])
+    def announce_block(self, block: Block, trace: str | None = None) -> None:
+        """`trace` is the author-minted trace id (node/tracing.py): it
+        rides the announce envelope OUTSIDE the signed payload, so
+        importers stitch their spans onto the author's trace."""
+        self._cast("sync_announce", [block.to_json(), trace])
 
     def broadcast_extrinsic(self, ext) -> None:
         """Tx gossip (the reference pool's propagation role): peers get
@@ -467,8 +498,12 @@ class SyncManager:
             try:
                 if self.faults is not None:
                     self.faults.rpc_gate((host, port), method)
-                return _rpc(host, port, method, params, timeout)
+                out = _rpc(host, port, method, params, timeout)
+                self._mark_peer_seen((host, port))
+                return out
             except RpcError:
+                # a definitive reply is still a live round-trip
+                self._mark_peer_seen((host, port))
                 raise
             except OSError as e:
                 last = e
@@ -592,7 +627,9 @@ class SyncManager:
             except _rpc_errors():
                 break
             try:
-                rec = s.import_block(Block.from_json(d["block"]))
+                rec = s.import_block(Block.from_json(d["block"]),
+                                     trace=d.get("trace"),
+                                     origin="catchup")
             except BlockImportError as e:
                 if "unknown parent" in str(e) and rewinds < 2:
                     rewinds += 1
@@ -633,6 +670,13 @@ class SyncManager:
         the caller may try again after the boundary imports.  -2 means
         the range FETCH failed (transient peer stall / unsupported
         method) — retryable, unlike a verification refusal."""
+        s = self.service
+        with s.tracer.span("catchup.range", tags={"gap": gap}) as span:
+            got = self._batch_import_inner(host, port, gap)
+            span.tags["imported"] = got
+            return got
+
+    def _batch_import_inner(self, host: str, port: int, gap: int) -> int:
         from ..consensus import engine
         from ..ops import bls_agg as _agg
         from .service import Extrinsic
@@ -694,7 +738,9 @@ class SyncManager:
         imported = 0
         for blk, d in blocks:
             try:
-                rec = s.import_block(blk, sigs_verified=True)
+                rec = s.import_block(blk, sigs_verified=True,
+                                     trace=d.get("trace"),
+                                     origin="catchup-batch")
             except (BlockImportError, SyncGap, KeyError, ValueError,
                     TypeError, AttributeError):
                 break

@@ -127,9 +127,13 @@ def run_epoch(
     n_offences: int = 8,
     seed: int = 7,
     check: bool = True,
+    tracer=None,
 ) -> EpochReport:
     """Run one epoch's device workload over `mesh`.  All batch sizes are
-    rounded up to multiples of the mesh size."""
+    rounded up to multiples of the mesh size.  `tracer`
+    (node/tracing.py Tracer) records one `epoch.run` trace with a span
+    per stage, so dryrun epoch steps land in the same span-tree
+    telemetry the live node emits."""
     n_dev = mesh.devices.size
     rnd = random.Random(seed)
     nprng = np.random.default_rng(seed)
@@ -273,6 +277,17 @@ def run_epoch(
         offences_ok = offences_ok and _off.verify_report(
             rep, "epoch-sim", {"v0": vpks[0]}.get
         )
+
+    if tracer is not None:
+        with tracer.span(
+            "epoch.run", tags={"devices": n_dev, "proofs": n_proofs}
+        ) as root:
+            for stage, dur in seconds.items():
+                tracer.event(f"epoch.{stage}", duration=dur)
+        # the stages ran before the span opened: back-date the root's
+        # duration to the measured epoch wall-clock (the ring holds
+        # the same Span object, so post-exit mutation is visible)
+        root.duration = sum(seconds.values())
 
     return EpochReport(
         n_devices=n_dev,

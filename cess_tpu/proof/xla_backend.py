@@ -24,6 +24,10 @@ declared verification seam (c-pallets/audit/src/lib.rs:484).
 
 from __future__ import annotations
 
+import os
+import threading
+import time as _time
+
 import jax
 import numpy as np
 
@@ -50,6 +54,71 @@ _COEFF_HEFF_BITS = _COEFF_BITS + 64
 # padded launch; above it the device SSWU path (ops/h2c.py) wins and
 # scales.  Verdicts are bit-identical either way (tests/test_h2c.py).
 _DEVICE_H2C_MIN_PAIRS = 256
+
+
+# ------------------------------------------------------- stage telemetry
+#
+# Always-on per-stage histograms of _combined_check (the promotion of
+# the opt-in profile_stages breakdown — ROADMAP item 1 needs per-stage
+# timing that survives outside bench.py).  They live in a process-wide
+# registry of their own so any host embedding a backend (node RPC,
+# TEE client, bench) exposes them without threading a registry through
+# the proof API; the node's `system_metrics` merges this registry into
+# its exposition (node/rpc.py).
+#
+# Overhead guard: each stage below already ends in a host
+# materialization, so a mark is ONE perf_counter call plus one locked
+# histogram observe — single-digit microseconds against stages that
+# cost milliseconds.  tests/test_telemetry.py measures the mark cost,
+# and bench.py's marginal ms/proof is the end-to-end check (< 2%
+# budget).  CESS_STAGE_METRICS=0 switches the marks off entirely for
+# A/B measurement.
+
+STAGE_NAMES = ("host_prep", "u_fold", "sigma_fold", "chunk_program",
+               "pairing")
+STAGE_METRICS_ENABLED = os.environ.get(
+    "CESS_STAGE_METRICS", "1") not in ("0", "false", "off")
+
+_stage_lock = threading.Lock()
+_stage_registry = None
+_stage_hists: dict = {}
+_stage_counters: dict = {}
+
+_STAGE_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                  0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0, 60.0)
+
+
+def proof_stage_registry():
+    """The process-wide metrics registry for the proof data plane
+    (created on first use; node/metrics is imported lazily to keep the
+    proof↔node package import graph acyclic)."""
+    global _stage_registry
+    with _stage_lock:
+        if _stage_registry is None:
+            from ..node import metrics as m
+
+            reg = m.Registry()
+            for name in STAGE_NAMES:
+                _stage_hists[name] = m.Histogram(
+                    f"cess_proof_stage_{name}_seconds",
+                    f"combined-check {name} stage time",
+                    buckets=_STAGE_BUCKETS, registry=reg)
+            _stage_counters["proofs"] = m.Counter(
+                "cess_proofs_verified",
+                "proof items covered by combined checks", reg)
+            _stage_counters["checks"] = m.Counter(
+                "cess_proof_checks",
+                "combined pairing checks executed", reg)
+            _stage_counters["seconds"] = m.Counter(
+                "cess_proof_verify_seconds_total",
+                "wall-clock seconds spent in combined checks", reg)
+            _stage_registry = reg
+    return _stage_registry
+
+
+def _observe_stage(name: str, seconds: float) -> None:
+    proof_stage_registry()
+    _stage_hists[name].observe(seconds)
 
 
 class XlaBackend(ProofBackend):
@@ -208,9 +277,9 @@ class XlaBackend(ProofBackend):
             return False
         if any(not 0 <= m < R for _, _, p in items for m in p.mu):
             return False
-        import time as _time
 
         stages = self.stage_seconds if self.profile_stages else None
+        metered = STAGE_METRICS_ENABLED
 
         def mark(name, t0):
             """Stage boundary: charge the elapsed wall clock to `name`.
@@ -219,14 +288,22 @@ class XlaBackend(ProofBackend):
             values, pairing_check is host) — a stage changed to return
             a device-resident array must add its own block_until_ready
             here or its cost silently migrates to the next bucket.
-            No-op when not profiling."""
-            if stages is None:
+            Always on: the per-stage histograms (proof_stage_registry)
+            observe every combined check; `profile_stages` additionally
+            accumulates the per-backend stage_seconds dict bench.py
+            logs.  One perf_counter + one locked observe per stage —
+            the measured-overhead guard in tests/test_telemetry.py."""
+            if not metered and stages is None:
                 return t0
             now = _time.perf_counter()
-            stages[name] = stages.get(name, 0.0) + (now - t0)
+            if stages is not None:
+                stages[name] = stages.get(name, 0.0) + (now - t0)
+            if metered:
+                _observe_stage(name, now - t0)
             return now
 
-        t0 = _time.perf_counter() if stages is not None else 0.0
+        check_t0 = _time.perf_counter()
+        t0 = check_t0
         batch_items = [podr2.BatchItem(n, c, p) for n, c, p in items]
         rhos = podr2.batch_rho(
             podr2.batch_transcript(seed, batch_items), len(items)
@@ -307,6 +384,12 @@ class XlaBackend(ProofBackend):
             [(lhs, -bls.G2_GENERATOR), (rhs, pk_point)]
         )
         mark("pairing", t0)
+        if metered:
+            proof_stage_registry()
+            _stage_counters["checks"].inc()
+            _stage_counters["proofs"].inc(len(items))
+            _stage_counters["seconds"].inc(
+                _time.perf_counter() - check_t0)
         return verdict
 
     def verify_batch(

@@ -51,6 +51,13 @@ def pytest_configure(config):
         "test_zz_chaos_*) — CI runs these as their own fast gate so a "
         "liveness regression fails loudly",
     )
+    config.addinivalue_line(
+        "markers",
+        "telemetry: observability suite (tests/test_telemetry.py — "
+        "tracing spans, per-block events, metrics exposition "
+        "round-trip, fleet reporter) — CI runs these as their own "
+        "fast gate",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -44,6 +44,9 @@ class TestMsmSharded:
 
 class TestEpochSim:
     def test_tiny_epoch_all_stages_check(self, mesh):
+        from cess_tpu.node import tracing
+
+        tracer = tracing.Tracer(node="epoch-test")
         report = run_epoch(
             mesh,
             n_segments=16,
@@ -56,6 +59,7 @@ class TestEpochSim:
             n_headers=8,
             n_validators=2,
             seed=11,
+            tracer=tracer,
         )
         assert report.rs_ok, "RS recovery diverged from the original data"
         assert report.combine_ok, "audit combine diverged from host"
@@ -71,6 +75,20 @@ class TestEpochSim:
             "rs", "audit_combine", "sigma_fold", "bls_aggregate",
             "vrf_headers", "offence_sweep",
         }
+        # the tracer got one epoch.run root (duration back-dated to
+        # the measured wall clock) with a point event per stage
+        spans = tracer.spans()
+        roots = [s for s in spans if s.name == "epoch.run"]
+        assert len(roots) == 1
+        assert roots[0].duration == pytest.approx(
+            sum(report.seconds.values()))
+        stage_names = {s.name for s in spans if s.name != "epoch.run"}
+        assert stage_names == {
+            f"epoch.{k}" for k in report.seconds
+        }
+        assert all(
+            s.trace_id == roots[0].trace_id for s in spans
+        )
 
     def test_batch_sizes_round_up_to_mesh(self, mesh):
         report = run_epoch(

@@ -446,7 +446,7 @@ class TestSessionPlanAndMigration:
         rt.offences.heartbeat("alice", rt.session.session_index)
         payload_version, data = checkpoint.decode_blob(
             checkpoint.snapshot(rt))
-        assert payload_version == checkpoint.FORMAT_VERSION == 4
+        assert payload_version == checkpoint.FORMAT_VERSION == 5
         # strip everything a v3 writer never emitted
         data.pop("session")
         data.pop("offences")

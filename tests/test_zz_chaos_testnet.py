@@ -15,6 +15,8 @@ exactly (determinism itself is asserted in tests/test_faults.py).
 
 Sorts last (zz) so a tier-1 timeout truncates it, not the broad suite."""
 
+import json
+import os
 import signal
 import socket
 import subprocess
@@ -142,6 +144,10 @@ def wait_for(pred, timeout: float, what: str, poll: float = 0.5):
 
 class TestChaosSoak:
     def test_hostile_network_soak(self, tmp_path):
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from tools.telemetry_report import FleetCollector, to_markdown
+
         spec_path = build_spec_file(tmp_path)
         ports = free_ports(3)
         procs = {}
@@ -153,12 +159,19 @@ class TestChaosSoak:
             for port in ports:
                 wait_rpc(port)
             port0 = ports[0]
+            # Fleet telemetry collector: sampled at every soak
+            # milestone; the soak ENDS by committing the throughput
+            # report artifact (SOAK_TELEMETRY.{json,md}) — ROADMAP
+            # item 5's metrics-backed report shape.
+            collector = FleetCollector([(HOST, p) for p in ports])
+            soak_t0 = time.time()
 
             # ---- liveness under faults: every node advances
             wait_for(
                 lambda: min(status(p)["number"] for p in ports) >= 2,
                 150, "all nodes past block 2",
             )
+            collector.sample()
 
             # ---- inject the equivocation: charlie double-votes a
             # future finality boundary; alice's replica proves the
@@ -201,6 +214,7 @@ class TestChaosSoak:
                     return False
 
             wait_for(has_idle_space, 90, "filler report on chain")
+            collector.sample()
 
             # ---- crash-restart from the SEED's schedule: kill the
             # chosen victim once its head passes the chosen block,
@@ -228,16 +242,23 @@ class TestChaosSoak:
                 )
 
             wait_for(challenged, 300, "OCW-driven challenge commit")
+            collector.sample()
 
-            from cess_tpu.proof import CpuBackend
+            from cess_tpu.proof import CpuBackend, XlaBackend
 
             backend = CpuBackend()
+            # TEE verification runs through the instrumented xla path
+            # (tiny geometry on the CPU mesh): its always-on per-stage
+            # histograms (proof/xla_backend.py) feed the telemetry
+            # report's per-proof breakdown — verdicts are bit-identical
+            # to CpuBackend (tests/test_proof_backends.py)
+            verify_backend = XlaBackend(fused=False, device_h2c=False)
             items = miner.answer_challenge(backend, PARAMS)
             assert items is not None
 
             results = wait_for(
                 lambda: tee.verify_missions(
-                    backend, PARAMS, {"miner-0": items}),
+                    verify_backend, PARAMS, {"miner-0": items}),
                 240, "verify mission assigned",
             )
             assert results == {"miner-0": (True, True)}
@@ -267,6 +288,7 @@ class TestChaosSoak:
                 return True
 
             wait_for(convicted, 240, "convictions applied on every node")
+            collector.sample()
             for p in ports:
                 free = rpc_call(HOST, p, "balances_free",
                                 ["pot/treasury"], timeout=5.0)
@@ -320,6 +342,52 @@ class TestChaosSoak:
                 return hashes if len(hashes) == 1 else None
 
             assert wait_for(converged, 90, "one finalized state hash")
+
+            # ---- event determinism survived the chaos: the finalized
+            # block's deposited events are bit-identical replica-wide
+            # (the crash-restarted node may have warp-synced past
+            # `fin` and so never executed it — like a pruned node it
+            # holds no events for it; replicas that DID execute must
+            # agree)
+            ev = []
+            for p in ports:
+                try:
+                    ev.append(rpc_call(HOST, p, "chain_getEvents",
+                                       [fin], timeout=5.0))
+                except RpcError:
+                    continue
+            assert len(ev) >= 2
+            assert len({e["digest"] for e in ev}) == 1
+
+            # ---- every soak ends with a committed telemetry report
+            # (ROADMAP item 5's metrics-backed throughput report):
+            # blocks/s, finality lag percentiles, import-stage and
+            # per-proof stage histograms, gossip drop totals
+            for _ in range(5):
+                collector.sample()
+                time.sleep(0.5)
+            from cess_tpu.proof.xla_backend import proof_stage_registry
+
+            report = collector.report(
+                extra_registries=(proof_stage_registry(),),
+                elapsed_s=time.time() - soak_t0,
+            )
+            fleet = report["fleet"]
+            assert fleet["blocks_per_s"] > 0
+            assert "finality_lag_p50" in fleet
+            assert "finality_lag_p95" in fleet
+            assert report["proof"].get("stages"), \
+                "per-proof stage histograms missing from the report"
+            root = os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))
+            with open(os.path.join(root, "SOAK_TELEMETRY.json"),
+                      "w") as fh:
+                fh.write(json.dumps(report, indent=2, sort_keys=True)
+                         + "\n")
+            with open(os.path.join(root, "SOAK_TELEMETRY.md"),
+                      "w") as fh:
+                fh.write(to_markdown(report) + "\n")
+
             miner.close()
             tee.close()
             stash.close()

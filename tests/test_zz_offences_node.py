@@ -290,7 +290,7 @@ class _NullSync:
     def __init__(self):
         self.peers = []
 
-    def announce_block(self, block):
+    def announce_block(self, block, trace=None):
         pass
 
     def broadcast_extrinsic(self, ext):

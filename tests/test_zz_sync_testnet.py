@@ -234,6 +234,60 @@ class TestThreeProcessTestnet:
                 "identical epoch randomness on every replica",
             )
 
+            # ---- observability acceptance: chain_getEvents for the
+            # finalized block is BIT-IDENTICAL on every replica (the
+            # per-block event ring is deterministic telemetry), and
+            # the block's trace id — minted by its author, propagated
+            # through the gossip/catch-up envelopes — stitches
+            # author-side and import-side spans into ONE trace.
+            events = []
+            for p in ports:
+                try:
+                    events.append(rpc_call(
+                        HOST, p, "chain_getEvents", [fin], timeout=5.0))
+                except RpcError:
+                    # a node that warp-synced past `fin` never executed
+                    # it, so (like a pruned reference node) it holds no
+                    # events for it — replicas that DID execute the
+                    # block must agree bit-for-bit
+                    continue
+            assert len(events) >= 2
+            assert len({e["digest"] for e in events}) == 1
+            assert len({
+                json.dumps(e["events"], sort_keys=True) for e in events
+            }) == 1
+
+            def stitched_trace():
+                span_sets = []
+                tids = set()
+                for p in ports:
+                    got = rpc_call(HOST, p, "system_traces", [str(fin)],
+                                   timeout=5.0)
+                    if got.get("spans"):
+                        tids.add(got["traceId"])
+                        span_sets.append(
+                            {s["name"] for s in got["spans"]})
+                if len(tids) != 1:
+                    return False  # trace id must be SHARED, not local
+                names = set().union(*span_sets)
+                return ("block.author" in names
+                        and "block.import" in names
+                        and "import.execute" in names)
+
+            wait_for(
+                stitched_trace, 30,
+                "one stitched author+import trace for the finalized "
+                "block",
+            )
+
+            # ---- health satellites: lag/freshness observables
+            health = rpc_call(HOST, port0, "system_health", [],
+                              timeout=5.0)
+            assert health["bestBlock"] >= fin
+            assert health["finalityLag"] == (
+                health["bestBlock"] - health["finalizedBlock"])
+            assert health["peersSeen"], "peer freshness map populated"
+
             # ---- kill charlie; the remaining 2/3 keep finalizing
             procs["charlie"].send_signal(signal.SIGKILL)
             procs["charlie"].wait(timeout=30)

@@ -1,0 +1,49 @@
+"""Metrics lint: every registered metric must carry non-empty help text.
+
+CI gate (build-and-test.yml): constructs the full metric surface — a
+networked NodeService + SyncManager registry and the process-wide
+proof-stage registry — and fails if any metric would render without a
+# HELP line.  A nameless metric is unusable from a dashboard; this
+keeps the exposition self-describing as the surface grows.
+"""
+
+from __future__ import annotations
+
+import sys
+
+sys.path.insert(0, ".")
+
+
+def collect_registries():
+    from cess_tpu.node.chain_spec import local_spec
+    from cess_tpu.node.service import NodeService
+    from cess_tpu.node.sync import SyncManager
+    from cess_tpu.proof.xla_backend import proof_stage_registry
+
+    service = NodeService(local_spec(), authority="alice")
+    SyncManager(service, peers=[("127.0.0.1", 1)])
+    return {
+        "service": service.registry,
+        "proof": proof_stage_registry(),
+    }
+
+
+def main() -> int:
+    bad = []
+    total = 0
+    for origin, registry in collect_registries().items():
+        for metric in registry.metrics():
+            total += 1
+            if not getattr(metric, "help", ""):
+                bad.append(f"{origin}:{metric.name}")
+    if bad:
+        print("metrics missing help text:", file=sys.stderr)
+        for name in bad:
+            print(f"  {name}", file=sys.stderr)
+        return 1
+    print(f"metrics lint: {total} metrics, all with help text")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
