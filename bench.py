@@ -167,24 +167,39 @@ def bench_verify(n_proofs: int) -> tuple[float, float]:
     log(f"verify: B={n_proofs} in {t_full:.2f}s; B={n_proofs // 2} in "
         f"{t_half:.2f}s; marginal {per_proof * 1000:.1f} ms/proof")
 
-    # Per-stage attribution on a SEPARATE profiled pass (the stage
+    # Per-stage attribution on SEPARATE profiled passes (the stage
     # boundaries block the dispatch pipeline, so the timed runs above
     # stay clean): where a regression lives can no longer ship
-    # unmeasured.  fused=False is load-bearing: only the staged
-    # (non-fused) pipeline is instrumented, and on a real TPU the auto
-    # gate would otherwise route to the fused single-program path and
-    # log an empty breakdown.
+    # unmeasured.  Both pipelines are instrumented — fused=False forces
+    # the staged path, fused=True the single-program pipeline with its
+    # dispatch_wait stage (BENCH_PROFILE_FUSED=0 skips the second pass
+    # when one full verify is too expensive to repeat).
     prof = XlaBackend(profile_stages=True, fused=False)
     podr2.chunk_point.cache_clear()
     verdicts = prof.verify_batch(pk, items, b"bench-seed", params)
     assert all(verdicts)
-    total = sum(prof.stage_seconds.values()) or 1.0
-    log("stages (profiled pass, B=%d): " % n_proofs + ", ".join(
-        f"{k}={v:.2f}s ({100 * v / total:.0f}%)"
-        for k, v in sorted(
-            prof.stage_seconds.items(), key=lambda kv: -kv[1]
-        )
-    ))
+
+    def log_stages(label, stage_seconds):
+        total = sum(stage_seconds.values()) or 1.0
+        log(f"stages ({label}, B={n_proofs}): " + ", ".join(
+            f"{k}={v:.2f}s ({100 * v / total:.0f}%)"
+            for k, v in sorted(
+                stage_seconds.items(), key=lambda kv: -kv[1]
+            )
+        ))
+
+    log_stages("staged profiled pass", prof.stage_seconds)
+    if os.environ.get("BENCH_PROFILE_FUSED", "1") not in ("0", "false"):
+        fprof = XlaBackend(profile_stages=True, fused=True)
+        podr2.chunk_point.cache_clear()
+        assert all(fprof.verify_batch(pk, items, b"bench-seed", params))
+        log_stages("fused profiled pass", fprof.stage_seconds)
+        host = fprof.stage_seconds.get("host_prep", 0.0)
+        wait = fprof.stage_seconds.get("dispatch_wait", 0.0)
+        if host + wait:
+            log(f"fused host/device overlap: {host / (host + wait):.2f} "
+                "(host_prep share of host_prep+dispatch_wait — prep "
+                "time under which device compute hid)")
     return t_full, per_proof
 
 
@@ -193,6 +208,8 @@ def bench_verify(n_proofs: int) -> tuple[float, float]:
 
 def main() -> None:
     enable_compile_cache()
+    import jax
+
     n_proofs = int(os.environ.get("BENCH_PROOFS", "1024"))
     # power of two: the grouped MSM pads the batch to one anyway, and the
     # marginal-slope calculation below assumes the padded lanes scale
@@ -205,6 +222,11 @@ def main() -> None:
     extrapolated = t_rs + per_proof * 100_000
     log(f"measured total (B={n_proofs} + {gib}GiB RS): {total:.2f}s; "
         f"100k-extrapolation {extrapolated:.1f}s")
+    # vs_baseline scores against a TPU-calibrated north star; reporting
+    # the ratio from any other platform produced misleading numbers like
+    # BENCH_r05's 0.0009, so non-TPU hosts emit null and the platform
+    # field says why.
+    platform = jax.default_backend()
     print(
         json.dumps(
             {
@@ -212,7 +234,12 @@ def main() -> None:
                           f"+rs{gib}gib_measured_s",
                 "value": round(total, 3),
                 "unit": "s",
-                "vs_baseline": round(60.0 / extrapolated, 4),
+                "platform": platform,
+                "vs_baseline": (
+                    round(60.0 / extrapolated, 4)
+                    if platform == "tpu"
+                    else None
+                ),
             }
         )
     )

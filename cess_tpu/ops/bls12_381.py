@@ -519,6 +519,95 @@ def g1_decompress_unchecked(data: bytes) -> G1Point:
     return G1Point(x, y)
 
 
+# ---------------------------------------------------- batched decompression
+#
+# One G1Point.from_bytes costs one fp_sqrt — a (p+1)/4 exponentiation,
+# ~0.3 ms per σ — plus a ~3 ms host Python subgroup ladder.  At
+# batch-verify scale that is the classic per-proof host residue.  Two
+# facts shape the batch form:
+#
+#  * Square roots do not batch: Montgomery's trick turns N inversions
+#    into one because inv(a_i) = inv(Πa)·Π_{j≠i}a_j, but the root of a
+#    product gives only the PRODUCT of the roots — there is no
+#    per-element relation to unwind, so each lane pays its own
+#    exponentiation.  CPython's pow() (C sliding-window) was measured
+#    5× faster per lane than a shared square-and-multiply chain over
+#    vectorised numpy uint64 limbs (the ops/g1.py design scaled to
+#    host), so the chain stays in C and the batch amortises the
+#    Python-level validation instead.
+#  * The subgroup ladder is the part worth moving: check_subgroup=False
+#    defers it so callers run ONE batched device [r]-chain
+#    (ops/glv.py subgroup_mask) over the whole batch — bit-identical
+#    rejection, none of the per-point host milliseconds.
+#
+# Bit-identity with the scalar path (fp_sqrt / from_bytes /
+# g1_decompress_unchecked), including the rejection set, is asserted in
+# tests/test_proof_hotpath.py.
+
+
+def fp_sqrt_batch(values: list[int]) -> list[int | None]:
+    """Batch fp_sqrt — literally a loop over the scalar helper (see the
+    module comment above: per-lane C pow() is the fastest chain), kept
+    as the batch seam so a future backend that CAN amortise roots slots
+    in without touching callers."""
+    return [fp_sqrt(v % P) for v in values]
+
+
+def g1_decompress_batch(
+    blobs: list[bytes], check_subgroup: bool = True
+) -> list[G1Point]:
+    """Batched compressed-G1 decompression, bit-identical to a loop of
+    G1Point.from_bytes (check_subgroup=True) or g1_decompress_unchecked
+    (check_subgroup=False): the same ValueError rejection set — bad
+    length, uncompressed/invalid-infinity flags, x ≥ p, non-residue x³+4,
+    and (when checked) non-subgroup points — and the same points out,
+    including the point at infinity and both sign flags.  Raises on the
+    FIRST invalid item of each validation phase; callers that need
+    per-item verdicts bisect, exactly as they do over the scalar path.
+
+    The square roots stay per-lane C pow() (fp_sqrt_batch — see the
+    module comment for why they don't batch); what the batch form
+    amortises is the Python-level validation and, via
+    check_subgroup=False, the subgroup ladder.  check_subgroup=False
+    is the fast path for verifiers that defer the subgroup test to the
+    batched device [r]-chain (ops/glv.py subgroup_mask)."""
+    n = len(blobs)
+    out: list[G1Point | None] = [None] * n
+    lanes: list[int] = []
+    xs: list[int] = []
+    large: list[bool] = []
+    for k, data in enumerate(blobs):
+        if len(data) != 48:
+            raise ValueError("G1 compressed point must be 48 bytes")
+        flags = data[0]
+        if not flags & 0x80:
+            raise ValueError("uncompressed G1 encoding unsupported")
+        if flags & 0x40:
+            if any(data[1:]) or flags & 0x3F:
+                raise ValueError("invalid infinity encoding")
+            out[k] = G1Point.infinity()
+            continue
+        x = int.from_bytes(bytes([flags & 0x1F]) + data[1:], "big")
+        if x >= P:
+            raise ValueError("x out of range")
+        lanes.append(k)
+        xs.append(x)
+        large.append(bool(flags & 0x20))
+    if lanes:
+        roots = fp_sqrt_batch([(x * x % P * x + G1Point.B) % P for x in xs])
+        for k, x, y, lg in zip(lanes, xs, roots, large):
+            if y is None:
+                raise ValueError("point not on curve")
+            if lg != (y > P - y):
+                y = P - y
+            out[k] = G1Point(x, y)
+        if check_subgroup:
+            for k in lanes:
+                if not out[k].in_subgroup():
+                    raise ValueError("point not in G1 subgroup")
+    return out
+
+
 def _jac_double_fq2(x: Fq2, y: Fq2, z: Fq2) -> tuple[Fq2, Fq2, Fq2]:
     if z.is_zero() or y.is_zero():
         return FQ2_ZERO, FQ2_ONE, FQ2_ZERO

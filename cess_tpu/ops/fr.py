@@ -56,6 +56,47 @@ def ints_to_limbs(xs, n: int) -> np.ndarray:
     return np.stack([int_to_limbs(int(x), n) for x in xs])
 
 
+def ints_to_words(xs, nbytes: int) -> np.ndarray:
+    """Iterable of ints (each < 2^(8·nbytes), nbytes % 4 == 0) →
+    (len, nbytes/4) uint32 little-endian words: one bytes pass, no
+    per-limb Python loops.  The word form is the shared wire shape the
+    vectorised limb codecs below unpack from."""
+    buf = b"".join(int(x).to_bytes(nbytes, "little") for x in xs)
+    n = len(buf) // nbytes if nbytes else 0
+    return np.frombuffer(buf, dtype="<u4").reshape(n, nbytes // 4)
+
+
+def words_to_limbs(
+    words: np.ndarray, limb_bits: int, nlimbs: int, dtype=np.int8
+) -> np.ndarray:
+    """(…, W) uint32 little-endian words → (…, nlimbs) exact
+    base-2^limb_bits limbs — the host mirror of the device unpackers
+    (proof/fused.py _mu_words_to_limbs / _u_words_to_limbs), vectorised
+    over any batch shape.  Bit-identical to ints_to_limbs /
+    g1.scalars_to_limbs for in-range values (tests/test_proof_hotpath.py);
+    limb_bits must be ≤ 25 so a limb spans at most two words."""
+    if limb_bits > 25:
+        raise ValueError("words_to_limbs: limb_bits must be <= 25")
+    w = np.asarray(words).astype(np.uint32, copy=False)
+    nwords = w.shape[-1]
+    out = np.zeros(w.shape[:-1] + (nlimbs,), dtype=np.uint32)
+    mask = np.uint32((1 << limb_bits) - 1)
+    for i in range(nlimbs):
+        lo_bit = limb_bits * i
+        wi, sh = lo_bit // 32, lo_bit % 32
+        if wi >= nwords:
+            break
+        val = w[..., wi] >> np.uint32(sh)
+        if sh + limb_bits > 32 and wi + 1 < nwords:
+            # uint32 wrap above bit 31 is harmless: every kept bit of
+            # the straddling word lands below bit limb_bits ≤ 25, and
+            # the mask drops the rest — measured 2.6× faster than the
+            # uint64 form at (1024, 265, 8)
+            val = val | (w[..., wi + 1] << np.uint32(32 - sh))
+        out[..., i] = val & mask
+    return out.astype(dtype)
+
+
 def limbs_to_int(limbs) -> int:
     x = 0
     for i, limb in enumerate(np.asarray(limbs).astype(np.int64).tolist()):

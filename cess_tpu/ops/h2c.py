@@ -59,6 +59,7 @@ from .g1 import (
     _prefix_or_and,
     _select,
     addm,
+    be48_to_limb_rows,
     fp_to_limbs,
     mulm,
     smallmul,
@@ -682,17 +683,9 @@ def _map_pairs_kernel(u, sgn, exc):
 
 def u_bytes_to_limbs(u_be: np.ndarray) -> np.ndarray:
     """(…, 48) big-endian canonical bytes → (33, …) int32 limbs,
-    vectorised (no per-element Python big-ints)."""
-    b = np.ascontiguousarray(u_be).astype(np.int32)
-    trip = b.reshape(b.shape[:-1] + (16, 3))
-    hi = (trip[..., 0] << 4) | (trip[..., 1] >> 4)
-    lo = ((trip[..., 1] & 0xF) << 8) | trip[..., 2]
-    pairs = np.stack([lo, hi], axis=-1)  # (…, 16, 2), BE triple order
-    pairs = pairs[..., ::-1, :]  # reverse triples → little-endian
-    limbs = pairs.reshape(b.shape[:-1] + (32,))
-    out = np.zeros(b.shape[:-1] + (L,), dtype=np.int32)
-    out[..., :NP_LIMBS] = limbs
-    return np.moveaxis(out, -1, 0)
+    vectorised — the limb-major view of g1.be48_to_limb_rows (one
+    shared byte-twiddle implementation)."""
+    return np.moveaxis(be48_to_limb_rows(u_be), -1, 0)
 
 
 def _u_host_fallback(names, name_ids, indices, dst):

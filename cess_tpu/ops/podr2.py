@@ -275,34 +275,64 @@ class BatchItem:
     proof: Podr2Proof
 
 
-def batch_transcript(seed: bytes, items: list["BatchItem"]) -> bytes:
+@lru_cache(maxsize=256)
+def _challenge_bytes(challenge: Challenge) -> bytes:
+    """The challenge's transcript contribution, packed once.  A live
+    audit round shares ONE Challenge across every proof of the batch, so
+    the per-proof transcript loop re-serialized the same 47 (index,
+    random) pairs N times; Challenge is a frozen (hashable) dataclass,
+    so the packed bytes cache by value.  Same zip-truncation semantics
+    as the rest of the scheme."""
+    return b"".join(
+        i.to_bytes(4, "little") + v
+        for i, v in zip(challenge.indices, challenge.randoms)
+    )
+
+
+def batch_transcript(
+    seed: bytes,
+    items: list["BatchItem"],
+    encodings: list[bytes] | None = None,
+) -> bytes:
     """Fiat–Shamir transcript binding the ρ weights to the proofs.
 
     The small-exponent batch test is only sound when the prover cannot
     predict the weights; hashing every (name, challenge, proof) into the
     seed makes ρ depend on the submitted proofs themselves, so cancelling
-    deviations cannot be pre-computed."""
+    deviations cannot be pre-computed.
+
+    `encodings` optionally supplies precomputed proof.encode() blobs so
+    one shared encode pass can feed both this transcript and the
+    verifier's μ word packing (proof/frontend.py); the digest is
+    byte-identical either way (blake2b streaming is concatenation-
+    associative), asserted in tests/test_proof_hotpath.py."""
     h = hashlib.blake2b(digest_size=32)
     h.update(RHO_DST)
     h.update(seed)
-    for it in items:
-        h.update(hashlib.sha256(it.name).digest())
-        for i, v in zip(it.challenge.indices, it.challenge.randoms):
-            h.update(i.to_bytes(4, "little"))
-            h.update(v)
-        h.update(it.proof.encode())
+    sha256 = hashlib.sha256
+    for k, it in enumerate(items):
+        h.update(sha256(it.name).digest())
+        h.update(_challenge_bytes(it.challenge))
+        h.update(
+            encodings[k] if encodings is not None else it.proof.encode()
+        )
     return h.digest()
 
 
 def batch_rho(transcript: bytes, count: int) -> list[int]:
     """Deterministic 128-bit batch weights from a transcript digest (both
-    backends derive identical combinations from identical inputs)."""
+    backends derive identical combinations from identical inputs).  The
+    (RHO_DST ‖ transcript) prefix is absorbed once and copied per weight
+    — hash-state copy + one 8-byte tail instead of re-hashing the prefix
+    N times; byte-identical to the one-shot form."""
+    prefix = hashlib.blake2b(digest_size=16)
+    prefix.update(RHO_DST)
+    prefix.update(transcript)
     out = []
     for b in range(count):
-        digest = hashlib.blake2b(
-            RHO_DST + transcript + b.to_bytes(8, "little"), digest_size=16
-        ).digest()
-        out.append(int.from_bytes(digest, "little") | 1)  # nonzero
+        h = prefix.copy()
+        h.update(b.to_bytes(8, "little"))
+        out.append(int.from_bytes(h.digest(), "little") | 1)  # nonzero
     return out
 
 

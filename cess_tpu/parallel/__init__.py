@@ -9,7 +9,12 @@ ICI — the role NCCL/MPI would play in a GPU framework, with no host-side
 gather in the loop.
 """
 
-from .verify import audit_data_plane_step, combine_mu_sharded, make_mesh
+from .verify import (
+    audit_data_plane_step,
+    combine_mu_sharded,
+    make_mesh,
+    pad_batch_rows,
+)
 from .msm import msm_sharded
 from .epoch_sim import EpochReport, run_epoch
 
@@ -18,6 +23,7 @@ __all__ = [
     "combine_mu_sharded",
     "make_mesh",
     "msm_sharded",
+    "pad_batch_rows",
     "run_epoch",
     "EpochReport",
 ]

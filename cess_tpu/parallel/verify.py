@@ -41,6 +41,18 @@ def make_mesh(n_devices: int | None = None) -> Mesh:
     return Mesh(mesh_devices, (BATCH_AXIS,))
 
 
+def pad_batch_rows(arr: np.ndarray, multiple: int) -> np.ndarray:
+    """Zero-pad the leading (batch) axis up to a multiple — the host
+    staging step every sharded entry point needs (ρ=0 / μ=0 rows are
+    combine-inert, so the padded result is bit-identical)."""
+    pad = (-arr.shape[0]) % multiple
+    if not pad:
+        return arr
+    return np.concatenate(
+        [arr, np.zeros((pad,) + arr.shape[1:], dtype=arr.dtype)]
+    )
+
+
 def _combine_local(w: jnp.ndarray, mu: jnp.ndarray) -> jnp.ndarray:
     """Local shard combine + cross-device sum + re-canonicalize."""
     part = fr.weighted_sum_kernel(w, jnp.moveaxis(mu, 0, -2))  # (S, 37)

@@ -19,9 +19,17 @@ the whole per-chunk group computation as ONE jitted device program:
                        u-side fold, two pairings on host.
 
 Transfers are packed to their information content (u: 96 B/pair,
-μ: 32 B/sector, σ: projective limb words) and every chunk's inputs are
-staged while the previous chunk computes (JAX async dispatch — the
-double-buffering called for by SURVEY.md §7 hard part 5).
+μ: 32 B/sector, σ: projective limb words).  The host front-end is the
+vectorised batch form (proof/frontend.py: batched σ decompression, one
+shared encode pass for transcript + μ words, word-level ρ packing), and
+chunks run a REAL double buffer: a one-worker prefetch pool packs chunk
+k+1's inputs while chunk k's program executes under JAX async dispatch,
+with nothing blocking on device values until every chunk is in flight
+(the double-buffering called for by SURVEY.md §7 hard part 5; the
+`dispatch_wait` stage histogram is the un-hidden device remainder —
+docs/perf.md).  With _one_shape() active every chunk pads to CHUNK
+proofs so `_verify_chunk_device` compiles exactly once per process,
+counted by COMPILE_COUNTS.
 
 Verdicts are bit-identical to the host reference (ops/podr2.py
 batch_verify): same ρ transcript, same zip-truncation semantics, same
@@ -36,6 +44,10 @@ Capability match: the reference's pairing-side verify
 
 from __future__ import annotations
 
+import os
+import threading
+import time as _time
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from functools import lru_cache, partial
 
@@ -47,10 +59,49 @@ from ..ops import bls12_381 as bls
 from ..ops import fr, g1, glv, h2c, podr2
 from ..ops.bls12_381 import G1Point, G2Point, R
 from ..ops.podr2 import Podr2Params
+from . import frontend
 
 # Proofs per device program: bounds HBM footprint and compile count
 # (every chunk of the same size reuses the executable).
 CHUNK = 1024
+
+# Trace-time counters for the jitted chunk programs: jax re-traces only
+# on a new argument-shape signature, so the count is the number of
+# distinct compiled executables this process built — the measurable form
+# of the one-shape invariant (tests/test_proof_hotpath.py asserts a
+# multi-chunk verify_batch compiles _verify_chunk_device exactly once).
+COMPILE_COUNTS = {"verify_chunk": 0}
+
+
+def _one_shape() -> bool:
+    """Pad every fused sub-batch to CHUNK proofs (dead lanes σ=∞, ρ=0,
+    μ=0) so `_verify_chunk_device` sees ONE shape per process.  Default:
+    on for TPU (a fused-program compile costs minutes; dead lanes cost
+    microseconds), off for the CPU test mesh (where tiny exact-shape
+    programs compile fast and padded ones run slow).
+    CESS_FUSED_ONE_SHAPE=1/0 forces either way."""
+    env = os.environ.get("CESS_FUSED_ONE_SHAPE")
+    if env is not None:
+        return env not in ("0", "false", "off")
+    return jax.default_backend() == "tpu"
+
+
+# One-deep host-prep prefetch: while chunk k's device program runs
+# (JAX async dispatch), the worker packs chunk k+1's inputs — XMD
+# hashing (native, GIL-releasing), limb packing, lane maps.  A single
+# worker is the whole double buffer: one chunk in prep, one in flight.
+_PREP_POOL: ThreadPoolExecutor | None = None
+_PREP_POOL_LOCK = threading.Lock()
+
+
+def _prep_pool() -> ThreadPoolExecutor:
+    global _PREP_POOL
+    with _PREP_POOL_LOCK:
+        if _PREP_POOL is None:
+            _PREP_POOL = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="fused-prep"
+            )
+    return _PREP_POOL
 
 
 # ------------------------------------------------------------ host packing
@@ -64,16 +115,17 @@ def pack_u_words(u_be: np.ndarray) -> np.ndarray:
 
 
 def pack_mu_words(mus: list[list[int]]) -> np.ndarray:
-    """B×S μ scalars (< 2^255) → (B, S, 8) uint32 little-endian words."""
+    """B×S μ scalars (< 2^255) → (B, S, 8) uint32 little-endian words.
+
+    The verify pipeline no longer calls this per proof: one shared
+    proof.encode() pass feeds both the transcript and the μ words
+    (proof/frontend.py mu_words — a numpy view over the encodings, so
+    the int→byte conversion happens once).  Kept, vectorised, for
+    callers that hold scalar matrices (bench crafting, tests)."""
     b = len(mus)
     s = len(mus[0]) if b else 0
-    buf = bytearray(b * s * 32)
-    pos = 0
-    for row in mus:
-        for m in row:
-            buf[pos : pos + 32] = m.to_bytes(32, "little")
-            pos += 32
-    return np.frombuffer(bytes(buf), dtype="<u4").reshape(b, s, 8)
+    buf = b"".join(m.to_bytes(32, "little") for row in mus for m in row)
+    return np.frombuffer(buf, dtype="<u4").reshape(b, s, 8)
 
 
 def pack_points_limbs(points: list[G1Point]) -> tuple[np.ndarray, ...]:
@@ -169,6 +221,7 @@ def _verify_chunk_device(
     (22, B) ladder limbs; rho_i8 (B, 19) int8 fr limbs; mu_words
     (B, S, 8) uint32.  Returns partial lhs/rhs triples (33,), exps
     (S, 37) and the σ subgroup mask (B,)."""
+    COMPILE_COUNTS["verify_chunk"] += 1  # trace-time: one per shape
     B, G = lane_map.shape
 
     # hash-to-curve: unpack u, split predicates, run the fused map
@@ -266,6 +319,7 @@ def combined_check_fused(
     items: list,
     seed: bytes,
     params: Podr2Params,
+    stages: dict | None = None,
 ) -> bool:
     """Bit-identical replacement for the stage-by-stage combined check.
 
@@ -274,36 +328,102 @@ def combined_check_fused(
       * undecodable pk or σ, wrong μ width, out-of-range μ, or a σ
         outside the r-order subgroup → False
       * otherwise the single combined pairing equation decides.
-    """
+
+    Host front-end is the vectorised batch form (proof/frontend.py):
+    batched σ decompression with the subgroup test left on the device
+    chain, ONE proof.encode() pass feeding transcript + μ words, and
+    word-level ρ packing.  Chunks run through a double-buffered
+    pipeline: chunk k's device program is dispatched asynchronously
+    while a prefetch worker packs chunk k+1's host inputs, and nothing
+    blocks on device values until every chunk is in flight — the
+    `dispatch_wait` stage below is exactly the device time the host
+    prep failed to hide.
+
+    Telemetry mirrors the staged path (same histogram names +
+    dispatch_wait; cess_proof_* counters), and `stages` accumulates the
+    per-call breakdown when the backend profiles."""
     if not items:
         return True
+    from .xla_backend import (
+        STAGE_METRICS_ENABLED,
+        _observe_stage,
+        _stage_counters,
+        proof_stage_registry,
+    )
+
+    metered = STAGE_METRICS_ENABLED
+
+    def mark(name, t0):
+        if not metered and stages is None:
+            return t0
+        now = _time.perf_counter()
+        if stages is not None:
+            stages[name] = stages.get(name, 0.0) + (now - t0)
+        if metered:
+            _observe_stage(name, now - t0)
+        return now
+
+    check_t0 = _time.perf_counter()
+    t0 = check_t0
     try:
         pk_point = G2Point.from_bytes(pk)
-        sigmas = [
-            bls.g1_decompress_unchecked(p.sigma) for _, _, p in items
-        ]
     except ValueError:
+        return False
+    sigmas = frontend.decompress_sigmas(items)
+    if sigmas is None:
         return False
     if any(len(p.mu) != params.s for _, _, p in items):
         return False
-    if any(not 0 <= m < R for _, _, p in items for m in p.mu):
+    encs = frontend.encode_proofs(items)
+    if encs is None:
+        return False
+    mu_w = frontend.mu_words(encs, params.s)
+    if not frontend.mu_in_range(mu_w):
         return False
     batch_items = [podr2.BatchItem(n, c, p) for n, c, p in items]
     rhos = podr2.batch_rho(
-        podr2.batch_transcript(seed, batch_items), len(items)
+        podr2.batch_transcript(seed, batch_items, encodings=encs),
+        len(items),
     )
 
-    outs: list[_ChunkOut] = []
-    for start in range(0, len(items), CHUNK):
-        sub = items[start : start + CHUNK]
-        outs.append(
-            _dispatch_chunk(
-                sub,
-                sigmas[start : start + CHUNK],
-                rhos[start : start + CHUNK],
-                params,
-            )
+    # one program shape per call: every chunk shares (Bp, npad, g) —
+    # and with _one_shape() they are process-constant for a given
+    # challenge geometry, so _verify_chunk_device compiles once ever.
+    chunk = CHUNK
+    counts_all = [
+        min(len(ch.indices), len(ch.randoms)) for _, ch, _ in items
+    ]
+    cnt_max = max(counts_all)
+    g = 1 << max(0, (cnt_max - 1).bit_length())
+    tile = max(h2c._MAP_TILE, glv._GLV_TILE)
+    if _one_shape():
+        pad_b = chunk
+        pad_lanes = _tile_pad(max(chunk * cnt_max, 1), tile)
+    else:
+        pad_b = pad_lanes = None  # per-chunk pow2 / exact tiling
+
+    spans = list(range(0, len(items), chunk))
+
+    def prep(start):
+        return _prep_chunk(
+            items[start : start + chunk],
+            sigmas[start : start + chunk],
+            rhos[start : start + chunk],
+            mu_w[start : start + chunk],
+            counts_all[start : start + chunk],
+            params, pad_b, pad_lanes, g, tile,
         )
+
+    outs: list[_ChunkOut] = []
+    pool = _prep_pool()
+    fut = pool.submit(prep, spans[0])
+    for si in range(len(spans)):
+        host_in = fut.result()
+        t0 = mark("host_prep", t0)
+        if si + 1 < len(spans):
+            fut = pool.submit(prep, spans[si + 1])
+        outs.append(_launch_chunk(host_in))  # async device dispatch
+        t0 = mark("chunk_program", t0)
 
     # one device reduction over the chunk partials, one host pull
     lhs = _accumulate_points(
@@ -318,22 +438,34 @@ def combined_check_fused(
     )
     exps = _finalize_exps(jnp.stack([o.exps for o in outs]))
     masks = jnp.concatenate([o.mask for o in outs])
+    t0 = mark("chunk_program", t0)
+    jax.block_until_ready((lhs, rhs, exps, masks))
+    t0 = mark("dispatch_wait", t0)
 
     if not bool(np.all(np.asarray(masks) == 1)):
-        return False
-    lhs_pt = g1.projective_to_points(
-        *(np.asarray(a).reshape(1, -1) for a in lhs)
-    )[0]
-    rhs_pt = g1.projective_to_points(
-        *(np.asarray(a).reshape(1, -1) for a in rhs)
-    )[0]
-    exps_ints = fr.limbs_to_ints(np.asarray(exps))
+        verdict = False
+    else:
+        lhs_pt = g1.projective_to_points(
+            *(np.asarray(a).reshape(1, -1) for a in lhs)
+        )[0]
+        rhs_pt = g1.projective_to_points(
+            *(np.asarray(a).reshape(1, -1) for a in rhs)
+        )[0]
+        exps_ints = fr.limbs_to_ints(np.asarray(exps))
 
-    us = list(podr2.u_generators(params.s))
-    rhs_pt = rhs_pt + _u_fold(us, exps_ints)
-    return bls.pairing_check(
-        [(lhs_pt, -bls.G2_GENERATOR), (rhs_pt, pk_point)]
-    )
+        us = list(podr2.u_generators(params.s))
+        rhs_pt = rhs_pt + _u_fold(us, exps_ints)
+        t0 = mark("u_fold", t0)
+        verdict = bls.pairing_check(
+            [(lhs_pt, -bls.G2_GENERATOR), (rhs_pt, pk_point)]
+        )
+        mark("pairing", t0)
+    if metered:
+        proof_stage_registry()
+        _stage_counters["checks"].inc()
+        _stage_counters["proofs"].inc(len(items))
+        _stage_counters["seconds"].inc(_time.perf_counter() - check_t0)
+    return verdict
 
 
 def _u_fold(us: list[G1Point], exps: list[int]) -> G1Point:
@@ -356,15 +488,22 @@ def _u_fold(us: list[G1Point], exps: list[int]) -> G1Point:
     )[0]
 
 
-def _dispatch_chunk(sub, sigmas, rhos, params) -> _ChunkOut:
-    """Host-prep one chunk and dispatch its device program (async — the
-    next chunk's prep overlaps this chunk's compute)."""
+def _prep_chunk(
+    sub, sigmas, rhos, mu_w, counts, params,
+    pad_b: int | None, pad_lanes: int | None, g: int, tile: int,
+):
+    """Pack one chunk's device inputs on the host (runs on the prefetch
+    worker while the previous chunk's program executes).  pad_b /
+    pad_lanes pin the proof- and lane-axis padding (the one-shape
+    invariant); None falls back to per-chunk pow2 / exact tiling."""
     B = len(sub)
-    Bp = 1 << max(0, (B - 1).bit_length())  # tree_reduce needs a pow2
-    counts = [min(len(ch.indices), len(ch.randoms)) for _, ch, _ in sub]
+    Bp = pad_b if pad_b is not None else 1 << max(0, (B - 1).bit_length())
     n_pairs = sum(counts)
-    tile = max(h2c._MAP_TILE, glv._GLV_TILE)
-    npad = _tile_pad(max(n_pairs, 1), tile)
+    npad = (
+        pad_lanes
+        if pad_lanes is not None
+        else _tile_pad(max(n_pairs, 1), tile)
+    )
 
     # host XMD (native, threaded) → packed u words + predicate flags
     name_ids = np.repeat(np.arange(B, dtype=np.uint32), counts)
@@ -382,39 +521,45 @@ def _dispatch_chunk(sub, sigmas, rhos, params) -> _ChunkOut:
     fl[:n_pairs] = flags
 
     # per-lane GLV halves of the challenge coefficients
-    v_k1, v_k2, lane_map, lane_mask, g = _lane_scalars(
-        sub, counts, npad, Bp
+    v_k1, v_k2, lane_map, lane_mask = _lane_scalars(
+        sub, counts, npad, Bp, g
     )
 
     # pad the proof axis to Bp with (σ = ∞, ρ = 0, μ = 0) lanes: every
     # fold treats them as identity and [r]∞ = ∞ passes the mask
-    sigmas = sigmas + [G1Point.infinity()] * (Bp - B)
-    rhos = list(rhos) + [0] * (Bp - B)
-    mus = [p.mu for _, _, p in sub]
-    mus += [[0] * params.s] * (Bp - B)
+    sX, sY, sZ = pack_points_limbs(
+        sigmas + [G1Point.infinity()] * (Bp - B)
+    )
+    rho_digits = np.zeros((g1.R_LIMBS, Bp), dtype=np.int32)
+    rho_digits[:, :B] = frontend.rho_digits(rhos)
+    rho_i8 = np.zeros((Bp, 19), dtype=np.int8)
+    rho_i8[:B] = frontend.rho_limbs7(rhos)
+    mu_words = np.zeros((Bp, params.s, 8), dtype=np.uint32)
+    mu_words[:B] = mu_w
 
-    sX, sY, sZ = pack_points_limbs(sigmas)
-    rho_digits = g1.scalars_to_limbs(rhos).T  # (22, Bp)
-    rho_i8 = fr.ints_to_limbs(rhos, 19)
-    mu_words = pack_mu_words(mus)
+    return (
+        u_words, fl, v_k1, v_k2, lane_map, lane_mask,
+        sX, sY, sZ, rho_digits, rho_i8, mu_words,
+    )
 
+
+def _launch_chunk(host_in) -> _ChunkOut:
+    """Upload one prepped chunk and dispatch its device program — JAX
+    async dispatch returns immediately, so the caller's next prep (and
+    the prefetch worker's) overlap this chunk's device compute."""
     lhs, rhs, exps, mask = _verify_chunk_device(
-        jnp.asarray(u_words), jnp.asarray(fl),
-        jnp.asarray(v_k1), jnp.asarray(v_k2),
-        jnp.asarray(lane_map), jnp.asarray(lane_mask),
-        jnp.asarray(sX), jnp.asarray(sY), jnp.asarray(sZ),
-        jnp.asarray(rho_digits), jnp.asarray(rho_i8),
-        jnp.asarray(mu_words),
+        *(jnp.asarray(a) for a in host_in)
     )
     return _ChunkOut(lhs, rhs, exps, mask)
 
 
-def _lane_scalars(sub, counts, npad: int, Bp: int):
+def _lane_scalars(sub, counts, npad: int, Bp: int, g: int):
     """Per-lane GLV digit arrays + the lane→group gather map.  The
     all-same-challenge batch (one audit round's snapshot) takes a tiled
-    fast path; mixed challenges fall back to the per-lane loop."""
+    fast path; mixed challenges fall back to the per-lane loop.  `g` is
+    the group gather width, shared across chunks by the caller so every
+    chunk program has one shape."""
     B = len(sub)
-    g = 1 << max(0, (max(counts) - 1).bit_length()) if counts else 1
     v_k1 = np.zeros((glv.K_LIMBS, npad), dtype=np.int32)
     v_k2 = np.zeros((glv.K_LIMBS, npad), dtype=np.int32)
     lane_map = np.zeros((Bp, g), dtype=np.int32)
@@ -437,7 +582,7 @@ def _lane_scalars(sub, counts, npad: int, Bp: int):
             + np.arange(cnt, dtype=np.int32)[None]
         )
         lane_mask[:B, :cnt] = 1
-        return v_k1, v_k2, lane_map, lane_mask, g
+        return v_k1, v_k2, lane_map, lane_mask
     pos = 0
     for b, ((_, ch, _), cnt) in enumerate(zip(sub, counts)):
         coeffs = ch.coefficients()[:cnt]
@@ -446,7 +591,7 @@ def _lane_scalars(sub, counts, npad: int, Bp: int):
             lane_map[b, k] = pos + k
             lane_mask[b, k] = 1
         pos += cnt
-    return v_k1, v_k2, lane_map, lane_mask, g
+    return v_k1, v_k2, lane_map, lane_mask
 
 
 @jax.jit

@@ -33,7 +33,7 @@ import numpy as np
 
 from ..ops import bls12_381 as bls
 from ..ops import fr, g1, h2c, podr2
-from ..ops.bls12_381 import G1Point, G2Point, R
+from ..ops.bls12_381 import G1Point, G2Point
 from ..ops.podr2 import Challenge, Podr2Params, Podr2Proof
 from .backend import ProofBackend, ProveRequest, VerifyItem
 
@@ -66,6 +66,15 @@ _DEVICE_H2C_MIN_PAIRS = 256
 # the proof API; the node's `system_metrics` merges this registry into
 # its exposition (node/rpc.py).
 #
+# BOTH verify pipelines observe the same stage names: the staged path
+# below marks host_prep/u_fold/sigma_fold/chunk_program/pairing, and
+# the fused single-program path (proof/fused.py combined_check_fused)
+# marks host_prep/chunk_program/u_fold/pairing plus `dispatch_wait` —
+# the block on device results after every chunk is in flight, i.e. the
+# device time the double-buffered host prep failed to hide (σ work is
+# inside the fused chunk program, so sigma_fold has no fused
+# observations).  docs/perf.md explains how to read the split.
+#
 # Overhead guard: each stage below already ends in a host
 # materialization, so a mark is ONE perf_counter call plus one locked
 # histogram observe — single-digit microseconds against stages that
@@ -75,7 +84,7 @@ _DEVICE_H2C_MIN_PAIRS = 256
 # A/B measurement.
 
 STAGE_NAMES = ("host_prep", "u_fold", "sigma_fold", "chunk_program",
-               "pairing")
+               "dispatch_wait", "pairing")
 STAGE_METRICS_ENABLED = os.environ.get(
     "CESS_STAGE_METRICS", "1") not in ("0", "false", "off")
 
@@ -119,6 +128,48 @@ def proof_stage_registry():
 def _observe_stage(name: str, seconds: float) -> None:
     proof_stage_registry()
     _stage_hists[name].observe(seconds)
+
+
+def _subgroup_ok(points, device: bool | None = None) -> bool:
+    """True iff every point is in the r-order subgroup (or ∞) — the
+    shared deferred-subgroup gate behind g1_decompress_batch(
+    check_subgroup=False) on the staged verify and prove paths.
+
+    device=None is auto: ONE batched device [r]-chain (ops/glv.py
+    subgroup_mask) on a real TPU, where the whole batch costs
+    microseconds per point; the per-point host ladder on CPU hosts,
+    where the emulated chain measured ~3× SLOWER than the ladder
+    (10.7 vs 3.3 ms/point at 1024 lanes) — the same auto shape as
+    device_h2c.  CESS_DEVICE_SUBGROUP=1/0 forces either way (tests
+    force the device wiring on the CPU mesh).  Both routes are
+    bit-identical (tests/test_fused TestGlv subgroup_mask matrix)."""
+    if not points:
+        return True
+    if device is None:
+        env = os.environ.get("CESS_DEVICE_SUBGROUP")
+        if env is not None:
+            device = env not in ("0", "false", "off")
+        else:
+            device = jax.default_backend() == "tpu"
+    if not device:
+        return all(p.in_subgroup() for p in points)
+    import jax.numpy as jnp
+
+    from ..ops import glv
+    from ..ops.bls12_381 import G1Point as _G1
+    from .fused import pack_points_limbs
+
+    # pow2 ∞-pad with an 8-lane floor: tiny batches (single-proof
+    # bisection leaves, 1-item RPC verifies) share one compiled mask
+    # shape instead of one per batch size
+    m = max(8, 1 << max(0, (len(points) - 1).bit_length()))
+    X, Y, Z = pack_points_limbs(
+        list(points) + [_G1.infinity()] * (m - len(points))
+    )
+    mask = np.asarray(
+        glv.subgroup_mask(jnp.asarray(X), jnp.asarray(Y), jnp.asarray(Z))
+    )
+    return bool(np.all(mask == 1))
 
 
 class XlaBackend(ProofBackend):
@@ -267,16 +318,11 @@ class XlaBackend(ProofBackend):
         if use_fused:
             from .fused import combined_check_fused
 
-            return combined_check_fused(pk, items, seed, params)
-        try:
-            pk_point = G2Point.from_bytes(pk)
-            sigmas = [G1Point.from_bytes(p.sigma) for _, _, p in items]
-        except ValueError:
-            return False
-        if any(len(p.mu) != params.s for _, _, p in items):
-            return False
-        if any(not 0 <= m < R for _, _, p in items for m in p.mu):
-            return False
+            return combined_check_fused(
+                pk, items, seed, params,
+                stages=self.stage_seconds if self.profile_stages else None,
+            )
+        from . import frontend
 
         stages = self.stage_seconds if self.profile_stages else None
         metered = STAGE_METRICS_ENABLED
@@ -302,30 +348,62 @@ class XlaBackend(ProofBackend):
                 _observe_stage(name, now - t0)
             return now
 
+        # The whole front-end sits AFTER check_t0 so host_prep means
+        # the same thing on both pipelines (the fused path charges its
+        # front-end to host_prep too — bench/profile breakdowns are
+        # compared side by side).  Early rejections return before any
+        # mark, exactly like the fused path's.
         check_t0 = _time.perf_counter()
         t0 = check_t0
+        try:
+            pk_point = G2Point.from_bytes(pk)
+        except ValueError:
+            return False
+        # batched decompression with the subgroup test deferred: the
+        # per-σ host ladder (~3 ms each) becomes ONE device [r]-chain
+        # over the whole batch below — same rejection set
+        # (tests/test_proof_backends.py non-subgroup/tampered matrix).
+        sigmas = frontend.decompress_sigmas(items)
+        if sigmas is None:
+            return False
+        if any(len(p.mu) != params.s for _, _, p in items):
+            return False
+        encs = frontend.encode_proofs(items)
+        if encs is None:
+            return False
+        words = frontend.mu_words(encs, params.s)
+        if not frontend.mu_in_range(words):
+            return False
         batch_items = [podr2.BatchItem(n, c, p) for n, c, p in items]
         rhos = podr2.batch_rho(
-            podr2.batch_transcript(seed, batch_items), len(items)
+            podr2.batch_transcript(seed, batch_items, encodings=encs),
+            len(items),
         )
+        # μ limbs come from the SAME encode pass as the transcript — a
+        # numpy word unpack instead of B·S per-limb Python loops.
+        mu_limbs = frontend.mu_limbs(words)  # (B, S, 37)
+        t0 = mark("host_prep", t0)
+
+        # σ subgroup gate: the test deferred from decompression runs as
+        # one batched device [r]-chain (ops/glv.py subgroup_mask —
+        # bit-identical to the host in_subgroup ladder, tests/test_fused
+        # TestGlv), ∞-padded to a power of two ([r]∞ = ∞ passes).
+        sub_ok = _subgroup_ok(sigmas)
+        t0 = mark("sigma_fold", t0)
+        if not sub_ok:
+            return False
 
         # u-side exponents Σ_b ρ_b μ_bj: device limb matmul (ops/fr.py) —
         # sharded over the mesh when one is configured (ρ=0 row padding
         # contributes nothing to the combination).
-        mu_limbs = np.stack(
-            [fr.fr_to_limbs(p.mu) for _, _, p in items]
-        )  # (B, S, 37)
-        t0 = mark("host_prep", t0)
         if self.mesh is not None:
-            from ..parallel import combine_mu_sharded
+            from ..parallel import combine_mu_sharded, pad_batch_rows
 
             n_dev = self.mesh.devices.size
-            pad = (-len(items)) % n_dev
-            rho_limbs = fr.ints_to_limbs(rhos + [0] * pad, 19)
-            if pad:
-                mu_limbs = np.concatenate(
-                    [mu_limbs, np.zeros((pad,) + mu_limbs.shape[1:], np.int8)]
-                )
+            rho_limbs = pad_batch_rows(
+                frontend.rho_limbs7(rhos), n_dev
+            )
+            mu_limbs = pad_batch_rows(mu_limbs, n_dev)
             exps = fr.limbs_to_ints(
                 combine_mu_sharded(self.mesh, rho_limbs, mu_limbs)
             )
@@ -415,7 +493,12 @@ class XlaBackend(ProofBackend):
 
     def prove_batch(self, request: ProveRequest) -> list[Podr2Proof]:
         """μ on device (challenged sectors only — 47/1024 of the data moves
-        to HBM); σ = Π_c tag_{i_c}^{v_c} per fragment as one grouped MSM."""
+        to HBM); σ = Π_c tag_{i_c}^{v_c} per fragment as one grouped MSM.
+        Tag decompression is batched (ops/bls12_381.g1_decompress_batch)
+        with the subgroup test deferred to one device [r]-chain per chunk
+        — the per-tag host ladder cost ~3 ms × 47 tags × fragment; the
+        rejection set (ValueError on any malformed or non-subgroup tag)
+        matches the host reference's per-tag from_bytes."""
         params = request.params
         challenge = request.challenge
         coeffs = challenge.coefficients()
@@ -433,9 +516,14 @@ class XlaBackend(ProofBackend):
             sector_limbs = np.stack(batches)
             mu_all = fr.mu_aggregate(coeffs, sector_limbs)  # (n, S, 37)
 
+            flat = bls.g1_decompress_batch(
+                [tags[i] for tags in chunk_tags for i in challenge.indices],
+                check_subgroup=False,
+            )
+            self._require_subgroup(flat)
+            k = len(challenge.indices)
             tag_pts = [
-                [G1Point.from_bytes(tags[i]) for i in challenge.indices]
-                for tags in chunk_tags
+                flat[b * k : (b + 1) * k] for b in range(len(chunk_tags))
             ]
             sigmas = g1.msm_grouped(
                 tag_pts,
@@ -446,3 +534,10 @@ class XlaBackend(ProofBackend):
                 mu = fr.limbs_to_ints(mu_all[b])
                 proofs.append(Podr2Proof(sigma.to_bytes(), mu))
         return proofs
+
+    @staticmethod
+    def _require_subgroup(points: list[G1Point]) -> None:
+        """Raises the scalar path's 'point not in G1 subgroup'
+        ValueError if any point fails the batched device check."""
+        if points and not _subgroup_ok(points):
+            raise ValueError("point not in G1 subgroup")

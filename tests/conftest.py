@@ -58,6 +58,14 @@ def pytest_configure(config):
         "round-trip, fleet reporter) — CI runs these as their own "
         "fast gate",
     )
+    config.addinivalue_line(
+        "markers",
+        "proof_hotpath: verify-front-end bit-identity + one-shape "
+        "compile-counter suite (tests/test_proof_hotpath.py — batched "
+        "G1 decompression vs the scalar path, vectorized transcript/μ "
+        "packing byte-identity, fused pipeline parity) — CI runs these "
+        "as their own fast gate",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,133 @@
+"""Micro-benchmark of the verify host front-end at a given batch size.
+
+Measures the per-proof HOST Python the tentpole targets — σ
+decompression, the Fiat–Shamir transcript + ρ derivation, and μ
+packing/limb staging — so the number isolates the host residue on any
+host.  On a pre-vectorization checkout the same phases run through the
+scalar forms (per-σ G1Point.from_bytes including its host subgroup
+ladder, per-proof transcript hashing, per-limb μ staging), so running
+the tool from two checkouts on the same host gives an honest
+before/after (BENCH_r06.json frontend_microbench).
+
+On the vectorized checkout the subgroup test is no longer host work —
+it rides the batched device [r]-chain — so it is timed (warm) and
+reported separately as deferred_subgroup_device_s, outside the host
+total: on a TPU that chain is batch-parallel device time; on a CPU
+host it is emulation and honestly slow, but it is not the host-residue
+metric this tool tracks.
+
+Prints one JSON line.  BENCH_FRONTEND_PROOFS sets N (default 1024).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import sys
+import time
+
+
+def main() -> None:
+    from cess_tpu.ops import fr, podr2
+    from cess_tpu.ops import bls12_381 as bls
+    from cess_tpu.ops.podr2 import Challenge, Podr2Params
+
+    try:
+        from cess_tpu.proof import frontend
+        from cess_tpu.proof.xla_backend import _subgroup_ok
+    except ImportError:  # pre-vectorization checkout
+        frontend = None
+        _subgroup_ok = None
+
+    B = int(os.environ.get("BENCH_FRONTEND_PROOFS", "1024"))
+    params = Podr2Params()  # protocol geometry: s=265
+    rnd = random.Random(0xF0E)
+    indices = tuple(sorted(rnd.sample(range(params.n), 47)))
+    challenge = Challenge(
+        indices=indices, randoms=tuple(rnd.randbytes(20) for _ in indices)
+    )
+    # distinct valid σ points (subgroup members) + realistic μ vectors
+    sigma_pool = [
+        bls.G1_GENERATOR.mul(1000 + 7 * i).to_bytes()
+        for i in range(min(B, 64))
+    ]
+    items = []
+    for i in range(B):
+        mu = [rnd.getrandbits(248) for _ in range(params.s)]
+        proof = podr2.Podr2Proof(sigma_pool[i % len(sigma_pool)], mu)
+        items.append((b"fe-frag-%06d" % i, challenge, proof))
+
+    out = {"b": B, "vectorized": frontend is not None}
+
+    # 1. σ decompression (before: from_bytes incl. its host subgroup
+    # ladder — that ladder was host Python, i.e. exactly the residue)
+    t0 = time.perf_counter()
+    if frontend is not None:
+        pts = frontend.decompress_sigmas(items)
+        assert pts is not None
+    else:
+        pts = [bls.G1Point.from_bytes(p.sigma) for _, _, p in items]
+    t_dec = time.perf_counter() - t0
+
+    # 2. transcript + ρ (and the encode pass that feeds it)
+    batch_items = [podr2.BatchItem(n, c, p) for n, c, p in items]
+    t0 = time.perf_counter()
+    if frontend is not None:
+        encs = frontend.encode_proofs(items)
+        tr = podr2.batch_transcript(b"fe-seed", batch_items, encodings=encs)
+    else:
+        encs = None
+        tr = podr2.batch_transcript(b"fe-seed", batch_items)
+    rhos = podr2.batch_rho(tr, B)
+    t_tr = time.perf_counter() - t0
+
+    # 3. μ range check + packing to device-ready limb staging
+    t0 = time.perf_counter()
+    if frontend is not None:
+        words = frontend.mu_words(encs, params.s)
+        assert frontend.mu_in_range(words)
+        mu_limbs = frontend.mu_limbs(words)
+    else:
+        import numpy as np
+
+        assert not any(
+            not 0 <= m < bls.R for _, _, p in items for m in p.mu
+        )
+        mu_limbs = np.stack([fr.fr_to_limbs(p.mu) for _, _, p in items])
+    t_mu = time.perf_counter() - t0
+
+    total = t_dec + t_tr + t_mu
+    out.update(
+        decompress_s=round(t_dec, 3),
+        transcript_rho_s=round(t_tr, 3),
+        mu_pack_s=round(t_mu, 3),
+        host_total_s=round(total, 3),
+        host_per_proof_ms=round(total / B * 1000, 3),
+    )
+
+    if _subgroup_ok is not None:
+        import jax
+
+        _subgroup_ok(pts[:8])  # warm the mask program at the floor shape
+        _subgroup_ok(pts)      # warm at the batch shape (compile excluded)
+        t0 = time.perf_counter()
+        assert _subgroup_ok(pts)
+        out["deferred_subgroup_s"] = round(time.perf_counter() - t0, 3)
+        env = os.environ.get("CESS_DEVICE_SUBGROUP")
+        device = (
+            env not in ("0", "false", "off")
+            if env is not None
+            else jax.default_backend() == "tpu"
+        )
+        out["subgroup_route"] = "device-chain" if device else "host-ladder"
+
+    print(json.dumps(out))
+    del mu_limbs, rhos
+
+
+if __name__ == "__main__":
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    main()

@@ -2,7 +2,10 @@
 
 Times each stage of _combined_check separately on the real chip:
 proofgen, rho derivation, mu combine (fr), sigma MSM, host XMD,
-device SSWU map, grouped H-MSM, rho fold, u-side MSM, pairing.
+device SSWU map, grouped H-MSM, rho fold, u-side MSM, pairing —
+then runs the fused single-program pipeline under profile_stages and
+prints the host-vs-device overlap fraction from the stage histograms
+(host_prep vs dispatch_wait; docs/perf.md explains how to read it).
 """
 
 from __future__ import annotations
@@ -150,6 +153,41 @@ def main():
     print(f"  {'SUM':30s} {total * 1000:9.1f} ms", file=sys.stderr)
     print(f"  per-proof if all scales: {total / B * 1000:.2f} ms",
           file=sys.stderr)
+
+    # ---- fused pipeline pass + host/device overlap ------------------
+    # The fused path runs each chunk's group math as one async device
+    # program while the prefetch worker packs the next chunk's inputs;
+    # host_prep is the un-overlappable host time on the critical path
+    # and dispatch_wait the device time host prep failed to hide, so
+    # host_prep / (host_prep + dispatch_wait) is the overlap fraction.
+    from cess_tpu.proof.xla_backend import _stage_hists, proof_stage_registry
+
+    fprof = XlaBackend(profile_stages=True, fused=True)
+    podr2.chunk_point.cache_clear()
+    t0 = time.perf_counter()
+    assert all(fprof.verify_batch(pk, items, b"bench-seed", params))
+    print(f"fused profiled pass: {time.perf_counter() - t0:.2f}s",
+          file=sys.stderr)
+    for k, v in sorted(fprof.stage_seconds.items(), key=lambda kv: -kv[1]):
+        print(f"  fused {k:24s} {v * 1000:9.1f} ms", file=sys.stderr)
+    host = fprof.stage_seconds.get("host_prep", 0.0)
+    wait = fprof.stage_seconds.get("dispatch_wait", 0.0)
+    if host + wait:
+        print(f"  host/device overlap fraction: {host / (host + wait):.2f}",
+              file=sys.stderr)
+
+    # process-wide histogram totals (what a node exposes over RPC):
+    proof_stage_registry()
+    print("stage histogram totals (cess_proof_stage_*_seconds sums):",
+          file=sys.stderr)
+    for name, hist in sorted(_stage_hists.items()):
+        print(f"  {name:24s} n={hist.n:5d} sum={hist.total:9.3f}s",
+              file=sys.stderr)
+    h = _stage_hists.get("host_prep")
+    w = _stage_hists.get("dispatch_wait")
+    if h is not None and w is not None and (h.total + w.total):
+        print("  overlap fraction (histograms): "
+              f"{h.total / (h.total + w.total):.2f}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -86,7 +86,7 @@ class FleetCollector:
 
     IMPORT_STAGES = ("sig_batch", "execute", "snapshot")
     PROOF_STAGES = ("host_prep", "u_fold", "sigma_fold",
-                    "chunk_program", "pairing")
+                    "chunk_program", "dispatch_wait", "pairing")
 
     def __init__(self, nodes: list[tuple[str, int]], timeout: float = 5.0):
         self.nodes = list(nodes)
