@@ -33,7 +33,7 @@ import numpy as np
 from ..ops import bls12_381 as bls
 from ..ops import podr2
 from ..ops.podr2 import Challenge, Podr2Params, Podr2Proof
-from ..ops.rs import segment_code
+from ..ops.rs import RSStream, segment_code
 from ..proof import ProofBackend, get_backend, ias
 from ..proof.backend import ProveRequest
 from ..utils.hashing import Hash64
@@ -219,10 +219,14 @@ class NodeSim:
         )
         deal_info: list[SegmentList] = []
         fragment_payload: dict[Hash64, bytes] = {}
-        for s in range(0, len(content_padded), seg_bytes):
-            segment = content_padded[s : s + seg_bytes]
-            shards = np.frombuffer(segment, dtype=np.uint8).reshape(2, frag_bytes)
-            parity = np.asarray(self.rt_encode(shards))
+        # All segments RS-encode as ONE streamed batch (fixed-slab
+        # dispatches; multi-segment files stop paying a device round
+        # trip per segment).
+        segments = np.frombuffer(content_padded, dtype=np.uint8).reshape(
+            -1, 2, frag_bytes
+        )
+        parities = RSStream(self._rs).run_batch(segments)
+        for shards, parity in zip(segments, parities):
             all_shards = [shards[0], shards[1], parity[0]]
             frag_hashes = []
             for shard in all_shards:
@@ -232,7 +236,7 @@ class NodeSim:
                 frag_hashes.append(fh)
             deal_info.append(
                 SegmentList(
-                    hash=Hash64.of(segment), fragment_list=frag_hashes
+                    hash=Hash64.of(shards.tobytes()), fragment_list=frag_hashes
                 )
             )
         file_hash = Hash64.of(b"file:" + content_padded)
@@ -268,6 +272,48 @@ class NodeSim:
 
     def rt_encode(self, shards: np.ndarray):
         return self._rs.encode(shards)
+
+    def recover_file(
+        self, file_hash: Hash64, lost: dict[int, int] | None = None
+    ) -> bytes:
+        """Rebuild a file's plaintext from any k-of-(k+m) stored fragments
+        per segment (reference seam: the restoral-order market,
+        c-pallets/file-bank/src/lib.rs:936-1125).  `lost` optionally maps
+        segment index → fragment index to treat as unavailable on top of
+        the on-chain `avail` flags, so different segments recover from
+        DIFFERENT survivor sets — the grouped per-pattern rs.RSStream
+        path, one batched matmul per distinct erasure mask."""
+        f = self.rt.file_bank.file.get(file_hash)
+        if f is None:
+            raise KeyError(f"unknown file {file_hash}")
+        frag_bytes = self.params.fragment_bytes
+        k = self._rs.k
+        patterns: list[list[int]] = []
+        survivors = np.empty(
+            (len(f.segment_list), k, frag_bytes), dtype=np.uint8
+        )
+        for i, seg in enumerate(f.segment_list):
+            present: list[int] = []
+            for j, frag in enumerate(seg.fragment_list):
+                if not frag.avail or (lost is not None and lost.get(i) == j):
+                    continue
+                stored = self.store[frag.miner].fragments.get(frag.hash)
+                if stored is None:
+                    continue
+                survivors[i, len(present)] = np.frombuffer(
+                    stored.data, dtype=np.uint8
+                )
+                present.append(j)
+                if len(present) == k:
+                    break
+            if len(present) < k:
+                raise ValueError(
+                    f"segment {i}: only {len(present)} of {k} "
+                    "fragments available"
+                )
+            patterns.append(present)
+        data = RSStream(self._rs, present=patterns).run_batch(survivors)
+        return data.tobytes()[: f.file_size]
 
     # ------------------------------------------------------------ audit
 

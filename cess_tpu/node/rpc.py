@@ -107,12 +107,16 @@ class RpcApi:
         @method("system_metrics")
         def _metrics():
             # merged exposition: this service's registry + the
-            # process-wide proof-stage registry (proof/xla_backend.py
-            # observes its per-stage histograms there — always on)
+            # process-wide proof-stage and RS-stage registries
+            # (proof/xla_backend.py and ops/rs.py observe their
+            # per-stage histograms there — always on)
+            from ..ops.rs import rs_stage_registry
             from ..proof.xla_backend import proof_stage_registry
             from . import metrics as _m
 
-            return _m.render_merged(s.registry, proof_stage_registry())
+            return _m.render_merged(
+                s.registry, proof_stage_registry(), rs_stage_registry()
+            )
 
         @method("system_traces")
         def _traces(trace_id: str | None = None, limit: int = 32):

@@ -48,16 +48,13 @@ import random
 import time
 from dataclasses import dataclass, field
 
-import jax
-import jax.numpy as jnp
 import numpy as np
-from jax.experimental.shard_map import shard_map
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from ..ops import bls12_381 as bls
 from ..ops import bls_agg, fr, g1, rs
 from .msm import msm_sharded
-from .verify import BATCH_AXIS, audit_data_plane_step
+from .verify import audit_data_plane_step
 
 
 @dataclass
@@ -81,32 +78,6 @@ class EpochReport:
     def ok(self) -> bool:
         return (self.rs_ok and self.combine_ok and self.sigma_ok
                 and self.bls_ok and self.vrf_ok and self.offences_ok)
-
-
-# ------------------------------------------------------------ RS stage
-
-
-def _rs_recover_sharded(
-    mesh: Mesh, code: rs.RSCode, shards: np.ndarray, present: list[int]
-) -> np.ndarray:
-    """(B, k, n) surviving shards (batch sharded) → (B, k, n) data shards."""
-    inv = code.recovery_matrix(present)
-    bits = jnp.asarray(
-        rs._bit_matrix_cached(
-            np.ascontiguousarray(inv).tobytes(), code.k, code.k
-        ),
-        dtype=jnp.int8,
-    )
-    fn = jax.jit(
-        shard_map(
-            jax.vmap(rs._matmul_gf_bitplane, in_axes=(None, 0)),
-            mesh=mesh,
-            in_specs=(P(None, None), P(BATCH_AXIS, None, None)),
-            out_specs=P(BATCH_AXIS, None, None),
-            check_rep=False,
-        )
-    )
-    return np.asarray(fn(bits, jnp.asarray(shards)))
 
 
 # ------------------------------------------------------------ epoch
@@ -145,16 +116,28 @@ def run_epoch(
     n_segments, n_proofs = r(n_segments), r(n_proofs)
     n_signatures, n_headers = r(n_signatures), r(n_headers)
 
-    # ---------------- stage RS: recover every segment from (data1, parity)
-    code = rs.RSCode(2, 1)
+    # ---------------- stage RS: recover every segment from its survivors.
+    # Segment i loses fragment i % 3 — MIXED per-segment erasure patterns,
+    # grouped by survivor mask inside rs.RSStream (batch axis sharded
+    # over the mesh, one fixed-slab executable shared by every group).
+    code = rs.RSCode(2, 1, path="auto")
     data = nprng.integers(
         0, 256, size=(n_segments, 2, fragment_bytes), dtype=np.uint8
     )
-    parity = np.asarray(code.encode_batch(jnp.asarray(data)))
-    survivors = np.concatenate([data[:, 1:2], parity], axis=1)  # shards 1,2
-    _rs_recover_sharded(mesh, code, survivors[:n_dev], [1, 2])  # compile
+    parity = np.asarray(code.encode_batch(data))
+    allsh = np.concatenate([data, parity], axis=1)  # (B, 3, n)
+    patterns = [sorted({0, 1, 2} - {i % 3}) for i in range(n_segments)]
+    survivors = np.stack(
+        [allsh[i, patterns[i]] for i in range(n_segments)]
+    )
+    slab = min(rs.SLAB, n_segments)
+    rs.RSStream(  # compile: same (slab, k, n) geometry as the timed run
+        code, present=patterns[:n_dev], mesh=mesh, slab=slab
+    ).run_batch(survivors[:n_dev])
     t0 = time.perf_counter()
-    recovered = _rs_recover_sharded(mesh, code, survivors, [1, 2])
+    recovered = rs.RSStream(
+        code, present=patterns, mesh=mesh, slab=slab
+    ).run_batch(survivors)
     seconds["rs"] = time.perf_counter() - t0
     rs_ok = bool(np.array_equal(recovered, data)) if check else True
 

@@ -66,6 +66,15 @@ def pytest_configure(config):
         "packing byte-identity, fused pipeline parity) — CI runs these "
         "as their own fast gate",
     )
+    config.addinivalue_line(
+        "markers",
+        "rs_hotpath: RS data-plane bit-identity + one-shape "
+        "compile-counter suite (tests/test_rs_hotpath.py — tiled/"
+        "streamed/sharded/grouped paths vs the numpy reference, every "
+        "RS(2,1) erasure pattern, mixed per-segment patterns, the "
+        "compile-once counter across a multi-tile stream) — CI runs "
+        "these as their own fast gate",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

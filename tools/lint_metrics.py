@@ -18,6 +18,7 @@ def collect_registries():
     from cess_tpu.node.chain_spec import local_spec
     from cess_tpu.node.service import NodeService
     from cess_tpu.node.sync import SyncManager
+    from cess_tpu.ops.rs import rs_stage_registry
     from cess_tpu.proof.xla_backend import proof_stage_registry
 
     service = NodeService(local_spec(), authority="alice")
@@ -25,6 +26,7 @@ def collect_registries():
     return {
         "service": service.registry,
         "proof": proof_stage_registry(),
+        "rs": rs_stage_registry(),
     }
 
 
