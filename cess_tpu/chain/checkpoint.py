@@ -58,6 +58,7 @@ _PALLETS = (
     "audit",
     "rrsc",
     "evm",
+    "fees",
 )
 
 # Nested data-bearing helpers the extractor recurses into.
@@ -327,6 +328,9 @@ def _dataclass_registry() -> dict[str, type]:
 # v5: the deposited-event sink left the consensus state (events are
 #     the audit trail, kept per block outside the state hash —
 #     see _OFFCHAIN_FIELDS); blobs no longer carry state.events.
+# v6: the fees pallet entered the replicated state (chain/fees.py —
+#     per-block fee escrow, lifetime fee totals, per-author payout
+#     ledger for the 20/80 treasury/author split).
 #
 # MIGRATIONS[v] upgrades a decoded v payload dict to v+1; restore runs
 # the chain v → FORMAT_VERSION, so any supported older blob loads into
@@ -335,7 +339,7 @@ def _dataclass_registry() -> dict[str, type]:
 # entry here instead of breaking old fixtures.
 
 MAGIC = b"CESSCKPT"
-FORMAT_VERSION = 5
+FORMAT_VERSION = 6
 
 
 def _migrate_v1_to_v2(data: dict) -> dict:
@@ -390,8 +394,24 @@ def _migrate_v4_to_v5(data: dict) -> dict:
     return data
 
 
+def _migrate_v5_to_v6(data: dict) -> dict:
+    """Pre-fee-market blobs carry no fees pallet: seed it EXPLICITLY
+    zeroed (mirroring _migrate_v3_to_v4's explicit-empty rule) so a
+    migrated blob restores to the same state on every replica.  The
+    fee constants (base_fee / fee_per_weight / block_weight_limit) are
+    genesis config, not snapshot state — the receiving runtime's values
+    stand, exactly like session_length."""
+    if "fees" not in data:
+        data["fees"] = {
+            "block_fees": 0, "total_fees": 0,
+            "paid_author": {}, "paid_treasury": 0,
+        }
+    return data
+
+
 MIGRATIONS = {1: _migrate_v1_to_v2, 2: _migrate_v2_to_v3,
-              3: _migrate_v3_to_v4, 4: _migrate_v4_to_v5}
+              3: _migrate_v3_to_v4, 4: _migrate_v4_to_v5,
+              5: _migrate_v5_to_v6}
 
 
 # ---------------------------------------------------------------- API

@@ -24,6 +24,7 @@ from .audit import AuditPallet
 from .rrsc import RrscPallet
 from .cacher import CacherPallet
 from .evm import EvmPallet
+from .fees import FeesPallet
 from .file_bank import FileBankPallet
 from .offences import OffencesPallet
 from .oss import OssPallet
@@ -92,6 +93,15 @@ class RuntimeConfig:
     # boundaries.  Distinct from genesis_validators: candidates are
     # not seated until an election elects them.
     genesis_candidates: list = field(default_factory=list)
+    # Fee market (pallet-transaction-payment role, chain/fees.py):
+    # fee = base_fee + weight · fee_per_weight; a block's extrinsics may
+    # not exceed block_weight_limit total weight (enforced at authorship
+    # AND re-checked at import).  Defaults: ~0.0015 TOKEN for the
+    # cheapest call, ~0.026 TOKEN for the heaviest; the limit holds
+    # ~200 median calls per block.
+    base_fee: Balance = 1_000_000_000
+    fee_per_weight: Balance = 10_000_000
+    block_weight_limit: int = 100_000
     # Pinned attestation trust anchors (proof/ias.RootStore).  None skips
     # the attestation gate (unit-test pallets in isolation); the node sim
     # always pins a root (reference pins Intel's at
@@ -152,6 +162,10 @@ class Runtime:
         )
         self.rrsc = RrscPallet(self.state, self.staking, self.scheduler_credit)
         self.evm = EvmPallet(self.state)
+        self.fees = FeesPallet(
+            self.state, cfg.base_fee, cfg.fee_per_weight,
+            cfg.block_weight_limit,
+        )
 
         # Offences + sessions (im-online/offences/session role,
         # runtime/src/lib.rs:1484-1527): the session clock drives era

@@ -26,7 +26,8 @@ _GENESIS_KNOBS = (
     "one_day_block", "one_hour_block", "frozen_days", "space_unit_price",
     "era_duration_blocks", "eras_per_year", "credit_period_blocks",
     "audit_lock_time", "podr2_chunk_count", "sessions_per_era",
-    "genesis_candidates",
+    "genesis_candidates", "base_fee", "fee_per_weight",
+    "block_weight_limit",
 )
 
 

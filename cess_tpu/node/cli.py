@@ -44,16 +44,24 @@ def _cmd_run(args) -> int:
         spec.block_time_ms = args.block_time_ms
     if args.finality_period is not None:
         spec.finality_period = args.finality_period
-    service = NodeService(spec, authority=args.authority)
+    service = NodeService(
+        spec, authority=args.authority,
+        pool_max_count=args.pool_max_count,
+        pool_max_bytes=args.pool_max_bytes,
+    )
     service.chaos_mute = bool(args.chaos_mute)
     if args.import_state:
         with open(args.import_state, "rb") as fh:
             service.import_state(fh.read())
     faults = None
+    spam = None
     if args.chaos_seed is not None:
-        from .faults import FaultInjector
+        from .faults import PROFILES, FaultInjector, SpamDriver
 
         faults = FaultInjector(args.chaos_seed, args.chaos_profile)
+        profile = PROFILES[args.chaos_profile]
+        if profile.flood_accounts > 0:
+            spam = SpamDriver(service, profile, seed=args.chaos_seed)
     if args.peers:
         SyncManager(
             service, _parse_peers(args.peers),
@@ -74,6 +82,10 @@ def _cmd_run(args) -> int:
         flush=True,
     )
     service.start()
+    if spam is not None:
+        spam.start()
+        print(f"spam-driver: {len(spam.accounts)} accounts @ "
+              f"{spam.profile.flood_rate}/s", flush=True)
     try:
         if args.blocks:
             while service.rt.state.block_number < args.blocks:
@@ -84,6 +96,8 @@ def _cmd_run(args) -> int:
     except KeyboardInterrupt:
         pass
     finally:
+        if spam is not None:
+            spam.stop()
         service.stop()
         if service.sync is not None:
             service.sync.stop()
@@ -263,8 +277,13 @@ def build_parser() -> argparse.ArgumentParser:
                           "node's outbound gossip + catch-up RPC "
                           "(node/faults.py); same seed, same schedule")
     run.add_argument("--chaos-profile", default="mild",
-                     choices=["off", "light", "mild", "hostile"],
-                     help="fault-probability profile for --chaos-seed")
+                     choices=["off", "light", "mild", "hostile", "flood"],
+                     help="fault-probability profile for --chaos-seed "
+                          "(flood adds synthetic spam-account load)")
+    run.add_argument("--pool-max-count", type=int, default=None,
+                     help="hard tx-pool transaction bound (default 2048)")
+    run.add_argument("--pool-max-bytes", type=int, default=None,
+                     help="hard tx-pool wire-byte bound (default 1 MiB)")
     run.add_argument("--chaos-mute", action="store_true",
                      help="skip im-online heartbeats (a deliberately "
                           "silent validator for liveness drills — it "

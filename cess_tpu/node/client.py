@@ -97,13 +97,16 @@ class SigningClient(RpcClient):
         # the node's genesis hash binds signatures to this chain
         self.genesis = self.call("system_chainGenesis")
 
-    def submit(self, module: str, call: str, *args) -> str:
+    def submit(self, module: str, call: str, *args, tip: int = 0) -> str:
         nonce = self.call("author_nonce", self.account)
         ext = Extrinsic(
             signer=self.account, module=module, call=call,
-            args=list(args), nonce=nonce,
+            args=list(args), nonce=nonce, tip=tip,
         ).sign(self.sk, self.genesis)
         return self.call("author_submitExtrinsic", ext.to_json())
+
+    def estimate_fee(self, module: str, call: str, tip: int = 0) -> dict:
+        return self.call("fees_estimate", module, call, tip)
 
     def wait_blocks(self, n: int = 1, timeout: float = 30.0) -> None:
         start = self.call("chain_getHeader")["number"]

@@ -61,6 +61,13 @@ class ChaosProfile:
     reorder: float = 0.0
     partition: float = 0.0
     partition_len: int = 8
+    # Spam-flood load (the fee-market soak): the CLI spins up
+    # `flood_accounts` synthetic signers per node, each submitting
+    # ~`flood_rate` underpriced extrinsics per second at `flood_tip`.
+    # 0 accounts = no flood (all network-only profiles).
+    flood_accounts: int = 0
+    flood_rate: float = 0.0
+    flood_tip: int = 0
 
 
 PROFILES = {
@@ -78,6 +85,13 @@ PROFILES = {
     "hostile": ChaosProfile(
         "hostile", drop=0.20, delay=0.25, delay_ms=(20, 200),
         duplicate=0.10, reorder=0.10, partition=0.08, partition_len=10,
+    ),
+    # fee-market flood: light network faults + duplicate-heavy gossip
+    # (exercising the intake dedupe) while synthetic spam accounts
+    # hammer the pool with zero-tip traffic
+    "flood": ChaosProfile(
+        "flood", drop=0.02, delay=0.05, delay_ms=(5, 40),
+        duplicate=0.10, flood_accounts=6, flood_rate=8.0, flood_tip=0,
     ),
 }
 
@@ -201,6 +215,79 @@ class FaultInjector:
                 self.injected += 1
         if delay:
             time.sleep(delay)
+
+
+class SpamDriver:
+    """Synthetic spam load for the fee-market soak: round-robins
+    `flood_accounts` dev-seeded signers ("spam-0"…) through the node's
+    OWN intake at ~`flood_rate` submissions/s, all at `flood_tip` —
+    underpriced traffic that must lose the fee auction without starving
+    paying users.  Only accounts present in the chain spec participate
+    (the soak spec endows them with a few affordable fees each; dev and
+    local specs have none, so `--chaos-profile flood` degrades to its
+    network faults there).  Submissions are locally signed, so the
+    pairing skip (`_verified=True`) is sound and the driver doesn't
+    monopolize the host's BLS budget."""
+
+    def __init__(self, service, profile: ChaosProfile, seed: int = 0):
+        from .chain_spec import dev_sk
+
+        self.service = service
+        self.profile = profile
+        self.rnd = random.Random(int.from_bytes(hashlib.blake2b(
+            b"chaos-flood/%d" % int(seed), digest_size=8
+        ).digest(), "big"))
+        self.accounts = []
+        if service.spec.dev_seed:
+            for i in range(profile.flood_accounts):
+                name = f"spam-{i}"
+                if name in service.keys:
+                    self.accounts.append(
+                        (name, dev_sk(name, service.spec.chain_id)))
+        self.nonces = {name: 0 for name, _ in self.accounts}
+        self.submitted = 0
+        self.rejected = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="spam-driver", daemon=True)
+
+    def start(self) -> "SpamDriver":
+        if self.accounts and self.profile.flood_rate > 0:
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _run(self) -> None:
+        from .service import Extrinsic
+
+        svc = self.service
+        interval = 1.0 / self.profile.flood_rate
+        i = 0
+        while not self._stop.wait(interval * self.rnd.uniform(0.5, 1.5)):
+            name, sk = self.accounts[i % len(self.accounts)]
+            i += 1
+            nonce = max(self.nonces[name], svc.nonces.get(name, 0))
+            ext = Extrinsic(
+                signer=name, module="oss", call="authorize",
+                args=[self.accounts[i % len(self.accounts)][0]],
+                nonce=nonce, tip=self.profile.flood_tip,
+            ).sign(sk, svc.genesis)
+            try:
+                # gossip=False: the driver stress-tests THIS node's
+                # admission plane; re-broadcasting would only benchmark
+                # the fleet's signature-pairing throughput.  Peers still
+                # see every included spam via authored blocks (batch
+                # verification) and so stay in fee lockstep.
+                svc.submit_extrinsic(ext, gossip=False, _verified=True)
+                self.nonces[name] = nonce + 1
+                self.submitted += 1
+            except ValueError:
+                # pool backpressure / broke account / stale nonce — all
+                # expected spam fates; re-sync and keep flooding
+                self.rejected += 1
+                self.nonces[name] = svc.rt.state.nonces.get(name, 0)
 
 
 def crash_schedule(

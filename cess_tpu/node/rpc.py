@@ -19,7 +19,7 @@ import socketserver
 import threading
 from typing import Any, Callable
 
-from .service import Extrinsic, NodeService
+from .service import Extrinsic, FeeTooLow, NodeService, PoolFull
 
 
 class RpcError(Exception):
@@ -76,12 +76,17 @@ class RpcApi:
             with s._lock:
                 best = s.rt.state.block_number
                 finalized = s.finalized_number
+                pool = s.pool.stats(s.rt.state.nonces)
             return {
                 "peers": len(s.sync.peers) if s.sync is not None else 0,
                 "isSyncing": False,
                 "shouldHavePeers": len(s.spec.validators) > 1,
-                "txpool": len(s.pool),
-                "txPoolSize": len(s.pool),
+                "txpool": pool["count"],
+                # pending = executable nonce-contiguous runs; future =
+                # banded ahead of the chain nonce, waiting on a gap
+                "txPoolSize": {
+                    "pending": pool["pending"], "future": pool["future"],
+                },
                 "bestBlock": best,
                 # finality lag: the observable the GRANDPA
                 # accountable-safety drills need (PAPERS.md) — a node
@@ -195,8 +200,15 @@ class RpcApi:
         # ---- author
         @method("author_submitExtrinsic")
         def _submit(ext: dict):
+            # typed backpressure first (PoolFull/FeeTooLow are
+            # ValueError subclasses): clients distinguish "resubmit
+            # later / bump the fee" from permanent rejections
             try:
                 return s.submit_extrinsic(Extrinsic.from_json(ext))
+            except PoolFull as e:
+                raise RpcError(-32011, str(e))
+            except FeeTooLow as e:
+                raise RpcError(-32012, str(e))
             except (ValueError, KeyError) as e:
                 raise RpcError(-32010, str(e))
 
@@ -217,7 +229,73 @@ class RpcApi:
 
         @method("author_nonce")
         def _nonce(account: str):
-            return s.nonces.get(account, 0)
+            # floor at the CONSENSUS nonce: the intake high-water mark
+            # rolls back when pooled transactions are evicted or shed
+            # in a reorg, and must never hand a signer a nonce the
+            # chain has already consumed
+            with s._lock:
+                return max(s.nonces.get(account, 0),
+                           s.rt.state.nonces.get(account, 0))
+
+        @method("author_poolStatus")
+        def _pool_status():
+            """Weighted-mempool inspection: band sizes, byte usage vs
+            the hard bound, lifetime evictions."""
+            with s._lock:
+                st = s.pool.stats(s.rt.state.nonces)
+            return {
+                **st,
+                "maxCount": s.pool.max_count,
+                "maxBytes": s.pool.max_bytes,
+                "evictions": s.pool.evictions,
+            }
+
+        @method("chain_accountNonce")
+        def _chain_nonce(account: str):
+            """CONSENSUS nonce (state.nonces): how many of the
+            account's extrinsics actually executed in blocks — the
+            inclusion observable, distinct from author_nonce's
+            intake high-water mark."""
+            return s.rt.state.nonces.get(account, 0)
+
+        # ---- fees (pallet-transaction-payment RPC role)
+        @method("fees_estimate")
+        def _fee_estimate(module: str, call: str, tip: int = 0):
+            """Pre-submission fee quote: what this call costs and the
+            pool priority it would enter with."""
+            from ..chain import fees as fees_mod
+
+            weight = fees_mod.weight_of(module, call)
+            operational = fees_mod.is_operational(module, call)
+            fee = s.rt.fees.fee_of(module, call)
+            tip = int(tip)
+            return {
+                "weight": weight,
+                "baseFee": s.rt.fees.base_fee,
+                "feePerWeight": s.rt.fees.fee_per_weight,
+                "fee": fee,
+                "tip": tip,
+                "total": fee + tip,
+                "operational": operational,
+                "priority": fees_mod.priority(fee, tip, weight,
+                                              operational),
+            }
+
+        @method("fees_state")
+        def _fee_state():
+            """Fee-market consensus state: weight budget and where the
+            charged fees went (20/80 treasury/author split)."""
+            from ..chain.staking import TREASURY_POT
+
+            with s._lock:
+                f = s.rt.fees
+                return {
+                    "blockWeightLimit": f.block_weight_limit,
+                    "totalFees": f.total_fees,
+                    "paidAuthor": dict(f.paid_author),
+                    "paidTreasury": f.paid_treasury,
+                    "treasuryFree": s.rt.state.balances.free(TREASURY_POT),
+                }
 
         # ---- cess pallet views (rpc.rs custom-API role)
         @method("balances_free")

@@ -27,12 +27,13 @@ from __future__ import annotations
 import hashlib
 import threading
 import time
-from collections import OrderedDict, deque
+from collections import OrderedDict
 from dataclasses import dataclass, field
 
 from ..chain.runtime import Runtime
 from ..chain.types import DispatchError
 from ..chain import checkpoint
+from ..chain import fees as fees_mod
 from ..chain import offences as offences_mod
 from ..consensus import ClaimError, engine as consensus
 from ..ops import bls12_381 as bls
@@ -65,6 +66,9 @@ class Extrinsic:
     call: str
     args: list
     nonce: int
+    # Fee-market priority bump (pallet-transaction-payment's tip role):
+    # part of the signed payload, charged on top of the weight fee.
+    tip: int = 0
     signature: str = ""  # hex BLS signature over payload()
 
     def payload(self, genesis: str) -> bytes:
@@ -73,7 +77,7 @@ class Extrinsic:
         # must never diverge
         return canonical_json(
             [genesis, self.signer, self.module, self.call, self.args,
-             self.nonce]
+             self.nonce, self.tip]
         )
 
     def sign(self, sk: int, genesis: str) -> "Extrinsic":
@@ -89,7 +93,8 @@ class Extrinsic:
     def to_json(self) -> dict:
         return {
             "signer": self.signer, "module": self.module, "call": self.call,
-            "args": self.args, "nonce": self.nonce, "sig": self.signature,
+            "args": self.args, "nonce": self.nonce, "tip": self.tip,
+            "sig": self.signature,
         }
 
     @classmethod
@@ -97,6 +102,7 @@ class Extrinsic:
         return cls(
             signer=d["signer"], module=d["module"], call=d["call"],
             args=list(d["args"]), nonce=int(d["nonce"]),
+            tip=int(d.get("tip", 0)),
             signature=d.get("sig", ""),
         )
 
@@ -279,60 +285,303 @@ EXTRINSIC_DISPATCH: dict = {
 # ------------------------------------------------------------ tx pool
 
 
+class PoolFull(ValueError):
+    """Typed intake backpressure: the pool (or the signer's per-account
+    band) is at capacity and the incoming extrinsic cannot displace
+    anything — the RPC layer maps this to its own error code instead of
+    silently dropping."""
+
+
+class FeeTooLow(ValueError):
+    """Typed intake backpressure: the extrinsic's fee is insufficient —
+    an underbid replacement, or a signer who cannot pay the weight fee."""
+
+
+@dataclass
+class PoolEntry:
+    """One pooled extrinsic with its fee-market ordering data, computed
+    once at intake (chain/fees.py)."""
+
+    ext: Extrinsic
+    hash: str
+    priority: int  # fees.priority(): fee-per-weight, ×1000, op-boosted
+    weight: int
+    fee: int       # fee + tip the signer will be charged at application
+    size: int      # canonical wire bytes, counted against the byte bound
+    seq: int = 0   # intake order: the priority tiebreak (older first)
+
+
 class TxPool:
-    """FIFO pool with per-account nonce gating (BasicPool's ready/future
-    split, reference: node/src/service.rs:148-154)."""
+    """Priority-ordered weighted mempool (the reference pool's
+    ready/future split plus Substrate's fee-per-weight ordering).
 
-    def __init__(self) -> None:
+    Entries live in per-account nonce→entry maps.  An account's PENDING
+    band is the contiguous nonce run from its chain nonce; anything
+    past a gap is FUTURE, admitted only within `future_band` of the
+    contiguous end so a nonce-gapped account cannot pin slots.
+    Eviction always takes an account's TAIL (highest nonce), keeping
+    bands contiguous; the global count/byte bounds displace the
+    lowest-priority tail in the pool, and an extrinsic that cannot
+    displace anything is refused with a typed error (PoolFull /
+    FeeTooLow) instead of silently dropped."""
+
+    def __init__(self, max_count: int = 2048, max_bytes: int = 1 << 20,
+                 per_account: int = 16, future_band: int = 8) -> None:
         self._lock = threading.Lock()
-        self._ready: deque[Extrinsic] = deque()
-        self._seen: set[str] = set()
+        self.max_count = max_count
+        self.max_bytes = max_bytes
+        self.per_account = per_account
+        self.future_band = future_band
+        self._by_account: dict[str, dict[int, PoolEntry]] = {}
+        self._hashes: set[str] = set()
+        self._bytes = 0
+        self._count = 0
+        self._seq = 0
+        self.evictions = 0  # lifetime, mirrored into cess_pool_evictions
 
-    def submit(self, ext: Extrinsic, genesis: str) -> str:
-        h = ext.hash(genesis)
-        with self._lock:
-            if h in self._seen:
-                raise ValueError("duplicate extrinsic")
-            self._seen.add(h)
-            self._ready.append(ext)
-        return h
+    # -------------------------------------------------------- internals
 
-    def drain(self, limit: int) -> list[Extrinsic]:
-        with self._lock:
-            out = []
-            while self._ready and len(out) < limit:
-                out.append(self._ready.popleft())
-            return out
+    def _insert(self, entry: PoolEntry) -> None:
+        self._by_account.setdefault(
+            entry.ext.signer, {})[entry.ext.nonce] = entry
+        self._hashes.add(entry.hash)
+        self._bytes += entry.size
+        self._count += 1
 
-    def requeue(self, exts: list[Extrinsic], genesis: str) -> None:
-        """Put retracted-block extrinsics back at the FRONT of the pool
-        (the reorg path: a dropped block's transactions return to the
-        pool, as the reference's pool does on retraction).  Bypasses the
-        duplicate guard — these hashes were seen at original intake —
-        but skips anything already queued."""
-        with self._lock:
-            queued = {e.hash(genesis) for e in self._ready}
-            for ext in reversed(exts):
-                h = ext.hash(genesis)
-                if h in queued:
-                    continue
-                self._seen.add(h)
-                self._ready.appendleft(ext)
-                queued.add(h)
-
-    def prune(self, hashes: set[str], genesis: str) -> None:
-        """Drop queued extrinsics that just landed on chain via an
-        imported block (tx gossip means several pools hold the same
-        extrinsic; whoever authors first wins, the rest prune)."""
-        if not hashes:
+    def _drop(self, entry: PoolEntry) -> None:
+        acct = self._by_account.get(entry.ext.signer)
+        if acct is None or acct.get(entry.ext.nonce) is not entry:
             return
+        del acct[entry.ext.nonce]
+        if not acct:
+            del self._by_account[entry.ext.signer]
+        self._hashes.discard(entry.hash)
+        self._bytes -= entry.size
+        self._count -= 1
+
+    def _lowest_tail(self, skip: set[str],
+                     exclude_signer: str) -> "PoolEntry | None":
+        """The lowest-priority account-tail entry — the only entries
+        evictable without breaking a nonce band.  Never the incoming
+        signer's own tail (evicting it could gap the incoming nonce)."""
+        best = None
+        for signer, entries in self._by_account.items():
+            if signer == exclude_signer:
+                continue
+            # walk past already-chosen victims to the effective tail:
+            # the entries above it are being dropped in the same
+            # operation, so the band stays contiguous
+            tail = None
+            for n in sorted(entries, reverse=True):
+                if entries[n].hash not in skip:
+                    tail = entries[n]
+                    break
+            if tail is None:
+                continue
+            if best is None or (tail.priority, -tail.seq) < (
+                best.priority, -best.seq
+            ):
+                best = tail
+        return best
+
+    # ---------------------------------------------------------- intake
+
+    def submit(self, entry: PoolEntry, base: int) -> list[PoolEntry]:
+        """Admit one entry; `base` is the signer's CHAIN nonce (start of
+        the pending band).  Returns the entries evicted to make room.
+        Raises ValueError (duplicate / future-band), FeeTooLow (underbid
+        replacement), or PoolFull (capacity with nothing displaceable)."""
+        ext = entry.ext
         with self._lock:
-            self._ready = deque(
-                e for e in self._ready if e.hash(genesis) not in hashes
-            )
+            if entry.hash in self._hashes:
+                raise ValueError("duplicate extrinsic")
+            acct = self._by_account.get(ext.signer, {})
+            old = acct.get(ext.nonce)
+            if old is not None:
+                # fee-bump replacement: same account+nonce needs a ≥10%
+                # priority bump over the pooled transaction
+                required = old.priority + (old.priority + 9) // 10
+                if entry.priority < required:
+                    raise FeeTooLow(
+                        f"replacement underpriced: priority "
+                        f"{entry.priority} < required {required} "
+                        "(>=10% bump)")
+                self._seq += 1
+                entry.seq = self._seq
+                self._drop(old)
+                self._insert(entry)
+                return []
+            # future-nonce banding: past the contiguous run + band → out
+            nxt = base
+            while nxt in acct:
+                nxt += 1
+            if ext.nonce > nxt + self.future_band:
+                raise ValueError(
+                    f"nonce {ext.nonce} too far in the future "
+                    f"(accepting up to {nxt + self.future_band})")
+            victims: list[PoolEntry] = []
+            skip: set[str] = set()
+            if len(acct) >= self.per_account:
+                tail = acct[max(acct)]
+                if ext.nonce >= tail.ext.nonce:
+                    raise PoolFull(
+                        f"account {ext.signer} already has {len(acct)} "
+                        "pooled transactions")
+                victims.append(tail)
+                skip.add(tail.hash)
+            # global count/byte bounds: displace strictly-lower-priority
+            # tails, or refuse with typed backpressure
+            count = self._count - len(victims)
+            size = self._bytes - sum(v.size for v in victims)
+            while (count + 1 > self.max_count
+                   or size + entry.size > self.max_bytes):
+                victim = self._lowest_tail(skip, ext.signer)
+                if victim is None or victim.priority >= entry.priority:
+                    raise PoolFull(
+                        f"pool limit reached ({self._count} txs, "
+                        f"{self._bytes} bytes) and priority "
+                        f"{entry.priority} is too low to displace")
+                victims.append(victim)
+                skip.add(victim.hash)
+                count -= 1
+                size -= victim.size
+            self._seq += 1
+            entry.seq = self._seq
+            for v in victims:
+                self._drop(v)
+            self._insert(entry)
+            self.evictions += len(victims)
+            return victims
+
+    # -------------------------------------------------------- authoring
+
+    def select(self, max_count: int, max_weight: int,
+               bases: dict[str, int]) -> list[PoolEntry]:
+        """Greedy priority packing under the block weight limit (the
+        authoring drain): repeatedly take the highest-priority
+        EXECUTABLE entry — an account head whose nonce chains from its
+        chain nonce in `bases`.  An entry that would overflow the
+        remaining weight blocks its whole account for this block (nonce
+        contiguity forbids skipping just it).  Selected entries are
+        REMOVED; the reorg requeue path puts retracted ones back."""
+        out: list[PoolEntry] = []
+        weight = 0
+        with self._lock:
+            heads: dict[str, int] = {}
+            blocked: set[str] = set()
+            while len(out) < max_count:
+                best = None
+                for signer, entries in self._by_account.items():
+                    if signer in blocked:
+                        continue
+                    n = heads.get(signer, bases.get(signer, 0))
+                    e = entries.get(n)
+                    if e is None:
+                        continue  # gapped or drained: not executable
+                    if best is None or (e.priority, -e.seq) > (
+                        best.priority, -best.seq
+                    ):
+                        best = e
+                if best is None:
+                    break
+                if weight + best.weight > max_weight:
+                    blocked.add(best.ext.signer)
+                    continue
+                weight += best.weight
+                heads[best.ext.signer] = best.ext.nonce + 1
+                self._drop(best)
+                out.append(best)
+        return out
+
+    # ------------------------------------------------------ maintenance
+
+    def requeue(self, entries: list[PoolEntry],
+                bases: dict[str, int]) -> list[PoolEntry]:
+        """Put retracted-block extrinsics back (the reorg path) with
+        caller-recomputed priorities, skipping stale nonces and slots a
+        (possibly better-paying) replacement now holds.  The caps are
+        re-imposed afterwards: retraction is not a licence to exceed
+        the pool's memory bound, so the lowest-priority tails are shed
+        (peers that included the dead fork still hold them).  Returns
+        the shed entries so the caller can roll back nonce high-water
+        marks."""
+        with self._lock:
+            for entry in entries:
+                ext = entry.ext
+                if entry.hash in self._hashes:
+                    continue
+                if ext.nonce < bases.get(ext.signer, 0):
+                    continue
+                if ext.nonce in self._by_account.get(ext.signer, {}):
+                    continue
+                self._seq += 1
+                entry.seq = self._seq
+                self._insert(entry)
+            shed: list[PoolEntry] = []
+            skip: set[str] = set()
+            while (self._count - len(shed) > self.max_count
+                   or self._bytes - sum(v.size for v in shed)
+                   > self.max_bytes):
+                victim = self._lowest_tail(skip, "")
+                if victim is None:
+                    break
+                shed.append(victim)
+                skip.add(victim.hash)
+            for v in shed:
+                self._drop(v)
+            self.evictions += len(shed)
+            return shed
+
+    def prune(self, hashes: set[str], bases: dict[str, int]) -> None:
+        """Drop entries that just landed on chain via an imported block
+        (by hash) and anything the advanced chain nonces made stale —
+        several pools hold the same gossiped extrinsic; whoever authors
+        first wins, the rest prune."""
+        with self._lock:
+            for signer, entries in list(self._by_account.items()):
+                base = bases.get(signer, 0)
+                for n in list(entries):
+                    e = entries[n]
+                    if e.hash in hashes or n < base:
+                        self._drop(e)
+
+    # ------------------------------------------------------- inspection
+
+    def contains(self, h: str) -> bool:
+        with self._lock:
+            return h in self._hashes
+
+    def has(self, signer: str, nonce: int) -> bool:
+        with self._lock:
+            return nonce in self._by_account.get(signer, {})
+
+    def accounts(self) -> list[str]:
+        with self._lock:
+            return list(self._by_account)
+
+    def bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def stats(self, bases: dict[str, int]) -> dict:
+        """{count, bytes, pending, future}: the pending/future band
+        split against the given chain nonces (system_health's
+        txPoolSize view)."""
+        with self._lock:
+            pending = 0
+            for signer, entries in self._by_account.items():
+                n = bases.get(signer, 0)
+                while n in entries:
+                    pending += 1
+                    n += 1
+            return {
+                "count": self._count, "bytes": self._bytes,
+                "pending": pending, "future": self._count - pending,
+            }
 
     def __len__(self) -> int:
-        return len(self._ready)
+        with self._lock:
+            return self._count
 
 
 # ------------------------------------------------------------ service
@@ -368,6 +617,14 @@ TRACE_MAP_BLOCKS = 512
 # the per-block ring above is the durable per-block record.
 EVENT_SINK_MAX = 50_000
 
+# Bounded cache of permanently-rejected extrinsic hashes (stale nonce,
+# bad signature, negative tip): gossip re-delivers every extrinsic N-1
+# times, and a re-delivered reject must cost a dict lookup, not a
+# ~0.38s pairing — the _offences_seen fix (PR 7) applied to the tx
+# intake path.  Transient rejections (pool full, can't pay yet) are
+# deliberately NOT cached: they may succeed on redelivery.
+REJECT_CACHE_MAX = 8192
+
 
 class NodeService:
     """One chain node: Runtime + pool + block authoring + state export.
@@ -384,6 +641,8 @@ class NodeService:
         authority: str | None = None,
         ias_roots=None,
         registry: "m.Registry | None" = None,
+        pool_max_count: int | None = None,
+        pool_max_bytes: int | None = None,
     ) -> None:
         self.spec = spec
         self.authority = authority
@@ -410,7 +669,15 @@ class NodeService:
                 rep, self.genesis, self.keys.get
             )
         )
-        self.pool = TxPool()
+        self.pool = TxPool(
+            max_count=(pool_max_count if pool_max_count is not None
+                       else 2048),
+            max_bytes=(pool_max_bytes if pool_max_bytes is not None
+                       else 1 << 20),
+        )
+        # hash → rejection reason for PERMANENTLY invalid extrinsics
+        # (see REJECT_CACHE_MAX) — checked before the signature pairing
+        self._ext_rejected: OrderedDict[str, str] = OrderedDict()
         self.nonces: dict[str, int] = {}
         self.blocks: list[BlockRecord] = []
         self.slot = 0
@@ -544,25 +811,111 @@ class NodeService:
         self.m_events = m.Counter(
             "cess_events_deposited",
             "runtime events deposited by committed blocks", reg)
+        # Fee-market pool observability (always on, merged into
+        # system_metrics): depth/bytes track the weighted mempool,
+        # evictions and per-reason rejections make spam backpressure
+        # visible, fee_total is the fees charged by executed blocks.
+        self.m_pool_size = m.Gauge(
+            "cess_pool_size", "pooled transactions (pending + future)",
+            reg)
+        self.m_pool_bytes = m.Gauge(
+            "cess_pool_bytes", "pooled transaction wire bytes", reg)
+        self.m_pool_evict = m.Counter(
+            "cess_pool_evictions",
+            "pooled transactions evicted to make room", reg)
+        self.m_pool_reject = m.LabeledCounter(
+            "cess_pool_rejections", "intake rejections by reason",
+            "reason", reg)
+        self.m_pool_fee = m.Counter(
+            "cess_pool_fee_total",
+            "transaction fees charged by blocks this node executed", reg)
         self.registry = reg
 
     # ------------------------------------------------------ submission
 
+    def _cache_rejection(self, h: str, reason: str) -> None:
+        """Remember a PERMANENTLY invalid extrinsic hash (caller holds
+        the lock): redelivery re-raises from here before any pairing."""
+        self._ext_rejected[h] = reason
+        while len(self._ext_rejected) > REJECT_CACHE_MAX:
+            self._ext_rejected.popitem(last=False)
+
+    def _pool_entry(self, ext: Extrinsic, h: str) -> PoolEntry:
+        """Price an extrinsic for the pool (chain/fees.py): weight,
+        fee + tip, and the fee-per-weight priority ordering key."""
+        weight = fees_mod.weight_of(ext.module, ext.call)
+        operational = fees_mod.is_operational(ext.module, ext.call)
+        fee = self.rt.fees.fee_of(ext.module, ext.call)
+        return PoolEntry(
+            ext=ext, hash=h,
+            priority=fees_mod.priority(fee, ext.tip, weight, operational),
+            weight=weight, fee=fee + ext.tip,
+            size=len(canonical_json(ext.to_json())),
+        )
+
+    def _admission_check(self, ext: Extrinsic, h: str, span) -> None:
+        """Cheap fee/nonce admission (caller holds the lock), run
+        BEFORE the ~0.38s signature pairing so floods of stale, broke,
+        or malformed spam cost dict lookups only.  Permanently-invalid
+        shapes enter the rejection cache; transient ones (can't pay
+        YET) do not."""
+        chain_nonce = self.rt.state.nonces.get(ext.signer, 0)
+        if ext.nonce < chain_nonce:
+            msg = f"stale nonce {ext.nonce}: expected at least {chain_nonce}"
+            self._cache_rejection(h, msg)
+            span.tags["rejected"] = "stale-nonce"
+            self.m_pool_reject.inc("stale-nonce")
+            raise ValueError(msg)
+        if ext.tip < 0:
+            msg = "negative tip"
+            self._cache_rejection(h, msg)
+            span.tags["rejected"] = "negative-tip"
+            self.m_pool_reject.inc("negative-tip")
+            raise ValueError(msg)
+        if not self.rt.fees.can_pay(ext.signer, ext.module, ext.call,
+                                    ext.tip):
+            span.tags["rejected"] = "cannot-pay"
+            self.m_pool_reject.inc("cannot-pay")
+            raise FeeTooLow(
+                f"{ext.signer} cannot pay the "
+                f"{self.rt.fees.fee_of(ext.module, ext.call) + ext.tip} "
+                "fee")
+
+    def _update_pool_metrics(self) -> None:
+        self.m_pool.set(len(self.pool))
+        self.m_pool_size.set(len(self.pool))
+        self.m_pool_bytes.set(self.pool.bytes())
+
     def submit_extrinsic(self, ext: Extrinsic, gossip: bool = True,
                          _verified: bool = False) -> str:
-        """Pool intake: signature + nonce + whitelist validation (the
-        validate_transaction role).  Accepted extrinsics gossip to every
-        peer pool (`gossip=False` marks peer-received copies, which are
-        not re-broadcast — the mesh is fully connected), so whichever
-        validator authors next can include them even if this node's own
-        blocks keep losing fork choice.  `_verified=True` skips the
-        pairing check for extrinsics this node signed itself moments ago
-        (the OCW path) — a full verify there burns most of a slot."""
+        """Pool intake: signature + fee/nonce admission + weighted-pool
+        insertion (the validate_transaction role).  Ordering matters:
+        the payload-hash dedupe and the cheap fee/nonce checks run
+        BEFORE the signature pairing, so re-gossiped or underfunded
+        spam never pays the ~0.38s verify.  Accepted extrinsics gossip
+        to every peer pool (`gossip=False` marks peer-received copies,
+        which are not re-broadcast — the mesh is fully connected).
+        `_verified=True` skips the pairing check for extrinsics this
+        node signed itself moments ago (the OCW path) — a full verify
+        there burns most of a slot."""
         if (ext.module, ext.call) not in EXTRINSIC_DISPATCH:
             raise ValueError(f"unknown call {ext.module}::{ext.call}")
         pk = self.keys.get(ext.signer)
         if pk is None:
             raise ValueError(f"unknown signer {ext.signer}")
+        try:
+            h = ext.hash(self.genesis)
+        except ValueError:
+            raise ValueError("undecodable signature")
+        # Hash dedupe BEFORE anything expensive: a redelivered reject
+        # re-raises from the cache, a redelivered accept is idempotent.
+        with self._lock:
+            cached = self._ext_rejected.get(h)
+            if cached is None and self.pool.contains(h):
+                return h
+        if cached is not None:
+            self.m_pool_reject.inc("cached")
+            raise ValueError(cached)
         # Extrinsic intake mints a trace (the other trace root next to
         # block authorship): the span records validation cost and the
         # verdict, queryable via system_traces.
@@ -571,23 +924,53 @@ class NodeService:
             tags={"module": ext.module, "call": ext.call,
                   "signer": ext.signer},
         ) as span:
+            with self._lock:
+                self._admission_check(ext, h, span)
             if not _verified and not bls.verify(
                 pk, ext.payload(self.genesis), bytes.fromhex(ext.signature)
             ):
                 span.tags["rejected"] = "bad-signature"
+                with self._lock:
+                    self._cache_rejection(h, "bad signature")
+                self.m_pool_reject.inc("bad-signature")
                 raise ValueError("bad signature")
-            # nonce check-and-increment under the service lock:
-            # concurrent RPC threads must not both pass with the
-            # same nonce
+            # insert + high-water bookkeeping under the service lock:
+            # concurrent RPC threads must agree on band positions, and
+            # the chain may have advanced during the pairing above
             with self._lock:
-                expected = self.nonces.get(ext.signer, 0)
-                if ext.nonce != expected:
-                    span.tags["rejected"] = "bad-nonce"
-                    raise ValueError(f"bad nonce: expected {expected}")
-                self.nonces[ext.signer] = expected + 1
-                h = self.pool.submit(ext, self.genesis)
+                self._admission_check(ext, h, span)
+                entry = self._pool_entry(ext, h)
+                base = self.rt.state.nonces.get(ext.signer, 0)
+                try:
+                    evicted = self.pool.submit(entry, base)
+                except PoolFull as e:
+                    span.tags["rejected"] = "pool-full"
+                    self.m_pool_reject.inc("pool-full")
+                    raise e
+                except FeeTooLow as e:
+                    span.tags["rejected"] = "fee-too-low"
+                    self.m_pool_reject.inc("fee-too-low")
+                    raise e
+                except ValueError as e:
+                    span.tags["rejected"] = str(e)
+                    self.m_pool_reject.inc("bad-nonce")
+                    raise
+                # intake high-water = chain nonce + contiguous pooled
+                # run: what author_nonce hands the next client signer
+                hw = base
+                while self.pool.has(ext.signer, hw):
+                    hw += 1
+                if self.nonces.get(ext.signer, 0) < hw:
+                    self.nonces[ext.signer] = hw
+                for ev in evicted:
+                    # an evicted tail rolls its account's high-water
+                    # back so the slot can be re-signed
+                    if ev.ext.nonce < self.nonces.get(ev.ext.signer, 0):
+                        self.nonces[ev.ext.signer] = ev.ext.nonce
+                if evicted:
+                    self.m_pool_evict.inc(len(evicted))
             span.tags["hash"] = h[:16]
-        self.m_pool.set(len(self.pool))
+        self._update_pool_metrics()
         if gossip and self.sync is not None:
             self.sync.broadcast_extrinsic(ext)
         return h
@@ -630,6 +1013,23 @@ class NodeService:
                 record.receipts.append(receipt)
                 continue
             self.rt.state.nonces[ext.signer] = expected + 1
+            # Fee charge (chain/fees.py): happens after the nonce is
+            # consumed and BEFORE dispatch, Substrate-style — a failed
+            # dispatch still pays, an unpayable fee skips dispatch but
+            # still burns the nonce.  Deterministic: same charge on
+            # author and every importer.
+            try:
+                fee_paid = self.rt.fees.charge(
+                    ext.signer, ext.module, ext.call, ext.tip)
+            except DispatchError as e:
+                receipt = {**receipt, "ok": False, "error": f"fee: {e}"}
+                self.m_ext_err.inc()
+                record.extrinsics.append(receipt["hash"])
+                record.receipts.append(receipt)
+                continue
+            if fee_paid:
+                receipt["fee"] = fee_paid
+                self.m_pool_fee.inc(fee_paid)
             try:
                 if adapter is not None:
                     adapter(self.rt, ext.signer, ext.args)
@@ -755,7 +1155,15 @@ class NodeService:
                 self.tracer.event("author.claim", duration=claim_s)
                 parent = self.head_hash
                 slot = self.slot
-                exts = self.pool.drain(self.MAX_EXTRINSICS_PER_BLOCK)
+                # Greedy priority packing under the block weight limit
+                # (the BlockBuilder + weight-meter role): highest
+                # fee-per-weight first, nonce-contiguous per account.
+                entries = self.pool.select(
+                    self.MAX_EXTRINSICS_PER_BLOCK,
+                    self.rt.fees.block_weight_limit,
+                    self.rt.state.nonces,
+                )
+                exts = [en.ext for en in entries]
                 ev_base = self.rt.state.event_mark()
                 # the output is consensus state the moment the block
                 # exists: fold BEFORE run_blocks, so an era rotation
@@ -769,6 +1177,10 @@ class NodeService:
                     record = BlockRecord(
                         number=self.rt.state.block_number, author=author)
                     self._apply_extrinsics(exts, record)
+                    # fee split lands in the SAME block's state (before
+                    # the snapshot), so the state hash commits to it —
+                    # importers run the identical distribute
+                    self.rt.fees.distribute(author)
                 with self.tracer.span("author.snapshot"):
                     blob, shash = checkpoint.snapshot_and_hash(self.rt)
                 events = self.rt.state.events_since(ev_base)
@@ -809,16 +1221,25 @@ class NodeService:
         """Reorg aftercare: a retracted block's extrinsics go back into
         the pool so they land on the winning chain in a later block
         (the reference pool's retraction behavior) instead of vanishing."""
-        exts = []
+        entries = []
         for blk in blocks:
             for d in blk.extrinsics:
                 try:
-                    exts.append(Extrinsic.from_json(d))
+                    ext = Extrinsic.from_json(d)
+                    entries.append(
+                        self._pool_entry(ext, ext.hash(self.genesis)))
                 except (KeyError, TypeError, ValueError):
                     continue
-        if exts:
-            self.pool.requeue(exts, self.genesis)
-            self.m_pool.set(len(self.pool))
+        if entries:
+            # the state rollback already refunded their fees (fee state
+            # lives in the blob); requeue re-prices at pool priority so
+            # they compete for the next block like fresh submissions
+            shed = self.pool.requeue(entries, self.rt.state.nonces)
+            for ev in shed:
+                cur = self.nonces.get(ev.ext.signer, 0)
+                if ev.ext.nonce < cur:
+                    self.nonces[ev.ext.signer] = ev.ext.nonce
+            self._update_pool_metrics()
 
     def _rollback_head(
         self,
@@ -890,7 +1311,7 @@ class NodeService:
             self.rt.state.events.extend(head_events)
         if record is not None:
             self.blocks.append(record)
-            self.pool.prune(set(record.extrinsics), self.genesis)
+            self.pool.prune(set(record.extrinsics), self.rt.state.nonces)
 
     def import_block(
         self, block: Block, sigs_verified: bool = False,
@@ -1116,6 +1537,23 @@ class NodeService:
             exts = [Extrinsic.from_json(e) for e in block.extrinsics]
         except (KeyError, TypeError, ValueError) as e:
             raise BlockImportError(f"malformed extrinsic: {e!r}")
+        # Weight-limit re-check at import (the reference's CheckWeight
+        # role): an author stuffing an overweight block is rejected
+        # deterministically by every replica, BEFORE any pairing —
+        # weights come from the static table, so this is dict sums.
+        if len(exts) > self.MAX_EXTRINSICS_PER_BLOCK:
+            raise BlockImportError(
+                f"too many extrinsics: {len(exts)} > "
+                f"{self.MAX_EXTRINSICS_PER_BLOCK}")
+        total_weight = sum(
+            fees_mod.weight_of(e.module, e.call) for e in exts)
+        if total_weight > self.rt.fees.block_weight_limit:
+            raise BlockImportError(
+                f"overweight block: {total_weight} > "
+                f"{self.rt.fees.block_weight_limit}")
+        for ext in exts:
+            if ext.tip < 0:
+                raise BlockImportError("negative tip")
         # ONE weighted batch pairing covers the author's block
         # signature, the VRF slot proof, and every extrinsic signature
         # (1 + #distinct-keys Miller-loop groups instead of 2 per
@@ -1172,6 +1610,8 @@ class NodeService:
                 number=self.rt.state.block_number, author=block.author,
                 imported=True)
             self._apply_extrinsics(exts, record)
+            # identical fee split to produce_block, pre-snapshot
+            self.rt.fees.distribute(block.author)
         with self.tracer.span("import.snapshot"), \
                 self.m_import_stage["snapshot"].time():
             blob, shash = checkpoint.snapshot_and_hash(self.rt)
@@ -1188,7 +1628,8 @@ class NodeService:
         for ext in exts:
             cur = self.nonces.get(ext.signer, 0)
             self.nonces[ext.signer] = max(cur, ext.nonce + 1)
-        self.pool.prune(set(record.extrinsics), self.genesis)
+        self.pool.prune(set(record.extrinsics), self.rt.state.nonces)
+        self._update_pool_metrics()
         return record, blob, events
 
     def handle_announce(self, block_json: dict,
@@ -1756,13 +2197,23 @@ class NodeService:
             self.block_by_number[head.number] = head
             self.slot = max(self.slot, head.slot)
         self._state_blobs[anchor_hash] = checkpoint.snapshot(self.rt)
+        # Rebase the pool onto the restored consensus nonces: spent
+        # slots drop, survivors keep their fee-priced priority.  The
+        # rejection cache survives on purpose — a fee-rejected payload
+        # must not resurrect just because the chain index moved.
+        self.pool.prune(set(), self.rt.state.nonces)
         # Re-level the pool-intake high-water marks with the restored
-        # consensus nonces: a rejoined node serving author_nonce from a
-        # stale map would have clients sign already-spent nonces (every
-        # such extrinsic applies as a failed receipt chain-wide).
-        for acct, n in self.rt.state.nonces.items():
-            if self.nonces.get(acct, 0) < n:
-                self.nonces[acct] = n
+        # consensus nonces + surviving pooled runs: a rejoined node
+        # serving author_nonce from a stale map would have clients sign
+        # already-spent nonces (every such extrinsic applies as a
+        # failed receipt chain-wide).
+        for acct in set(self.rt.state.nonces) | set(self.pool.accounts()):
+            hw = self.rt.state.nonces.get(acct, 0)
+            while self.pool.has(acct, hw):
+                hw += 1
+            if self.nonces.get(acct, 0) < hw:
+                self.nonces[acct] = hw
+        self._update_pool_metrics()
 
     def import_state(self, blob: bytes) -> None:
         """Dev/CLI restore: state only, synthetic head anchor (multi-node

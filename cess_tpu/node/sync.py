@@ -67,8 +67,11 @@ def _rpc_errors() -> tuple[type, ...]:
 # events are per-block telemetry now), so a v3 peer computes different
 # state hashes for identical chains; announce/catch-up envelopes also
 # carry optional trace ids (node/tracing.py — telemetry, ignored by
-# verification).
-SYNC_PROTO_VERSION = 4
+# verification).  v5: the fee market (chain/fees.py) — extrinsics carry
+# a tip field in their signing payload, fee charging and the 20/80
+# split are consensus state (checkpoint v6), so a v4 peer computes
+# different extrinsic hashes and state hashes for identical chains.
+SYNC_PROTO_VERSION = 5
 
 # Peer-gossip socket timeout: announcements are fire-and-forget, a dead
 # peer must not stall the authoring loop.

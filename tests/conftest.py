@@ -68,6 +68,15 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "fees: fee-market + weighted-mempool suite (tests/test_fees.py "
+        "— weight-table completeness, priority ordering, fee-bump "
+        "replacement, typed backpressure, deterministic-fee lockstep, "
+        "overweight-block rejection; tests/test_zz_flood_testnet.py — "
+        "the 3-node spam-flood soak) — CI runs these as their own "
+        "fast gate",
+    )
+    config.addinivalue_line(
+        "markers",
         "rs_hotpath: RS data-plane bit-identity + one-shape "
         "compile-counter suite (tests/test_rs_hotpath.py — tiled/"
         "streamed/sharded/grouped paths vs the numpy reference, every "

@@ -118,9 +118,16 @@ class TestSemantics:
         assert sent == ["b", "a"]
 
     def test_profiles_registry(self):
-        assert set(PROFILES) == {"off", "light", "mild", "hostile"}
+        assert set(PROFILES) == {"off", "light", "mild", "hostile",
+                                 "flood"}
         assert PROFILES["hostile"].drop > PROFILES["mild"].drop
         # "light" is the sustained-soak profile: lossy link only, no
         # partitions (those are asserted above in this file instead)
         assert PROFILES["light"].partition == 0.0
         assert PROFILES["light"].drop > 0
+        # "flood" is the fee-market spam profile: synthetic accounts on
+        # a mostly-healthy network (only it floods; no partitions)
+        assert PROFILES["flood"].flood_accounts > 0
+        assert PROFILES["flood"].partition == 0.0
+        for name in ("off", "light", "mild", "hostile"):
+            assert PROFILES[name].flood_accounts == 0

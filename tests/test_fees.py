@@ -1,0 +1,508 @@
+"""Fee market + weighted priority mempool (chain/fees.py, the TxPool in
+node/service.py): weight-table completeness against the dispatch
+surface, fee math and the exact 20/80 treasury/author split, priority
+ordering / fee-bump replacement / typed backpressure / future-nonce
+banding in the pool, deterministic-fee lockstep across replicas, and
+the overweight-block import rejection."""
+
+import pytest
+
+from cess_tpu.chain import fees as fees_mod
+from cess_tpu.chain.runtime import Runtime, RuntimeConfig
+from cess_tpu.chain.staking import TREASURY_POT
+from cess_tpu.chain.types import DispatchError, TOKEN
+from cess_tpu.node import Extrinsic, NodeService
+from cess_tpu.node.chain_spec import dev_sk, dev_spec, local_spec
+from cess_tpu.node.metrics import scoped_registry
+from cess_tpu.node.service import (
+    EXTRINSIC_DISPATCH,
+    FeeTooLow,
+    PoolEntry,
+    PoolFull,
+    TxPool,
+)
+from cess_tpu.ops import bls12_381 as bls
+
+pytestmark = pytest.mark.fees
+
+
+def make_service(**kw) -> NodeService:
+    return NodeService(dev_spec(), registry=scoped_registry(), **kw)
+
+
+def signed(service, account, module, call, *args, nonce=None, tip=0,
+           sk=None, chain="dev"):
+    ext = Extrinsic(
+        signer=account, module=module, call=call, args=list(args),
+        nonce=service.nonces.get(account, 0) if nonce is None else nonce,
+        tip=tip,
+    )
+    return ext.sign(sk if sk is not None else dev_sk(account, chain),
+                    service.genesis)
+
+
+def entry(signer, nonce, priority, weight=100, size=100):
+    """Synthetic pool entry for TxPool unit tests (no signature —
+    the pool never verifies, intake does)."""
+    ext = Extrinsic(signer=signer, module="oss", call="authorize",
+                    args=[], nonce=nonce)
+    return PoolEntry(
+        ext=ext, hash=f"{signer}/{nonce}/p{priority}",
+        priority=priority, weight=weight, fee=0, size=size,
+    )
+
+
+# ------------------------------------------------------------ weight table
+
+
+class TestWeightTable:
+    def test_every_dispatch_call_has_a_weight(self):
+        missing = [k for k in EXTRINSIC_DISPATCH if k not in
+                   fees_mod.WEIGHTS]
+        assert not missing, f"unweighted dispatchables: {missing}"
+
+    def test_every_weight_names_a_dispatch_call(self):
+        orphans = [k for k in fees_mod.WEIGHTS
+                   if k not in EXTRINSIC_DISPATCH]
+        assert not orphans, f"weights for unknown calls: {orphans}"
+
+    def test_operational_calls_exist_and_are_free(self):
+        rt = Runtime()
+        for key in fees_mod.OPERATIONAL:
+            assert key in EXTRINSIC_DISPATCH
+            assert rt.fees.fee_of(*key) == 0
+
+    def test_unknown_call_gets_the_default_weight(self):
+        assert fees_mod.weight_of("no_such", "call") == \
+            fees_mod.DEFAULT_WEIGHT
+
+    def test_priority_is_fee_per_weight(self):
+        assert fees_mod.priority(1000, 0, 100) == 10_000
+        assert fees_mod.priority(1000, 500, 100) == 15_000
+        # heavier call, same fee → lower priority
+        assert fees_mod.priority(1000, 0, 200) < \
+            fees_mod.priority(1000, 0, 100)
+        # operational boost dominates any achievable fee rate
+        assert fees_mod.priority(0, 0, 60, operational=True) > \
+            fees_mod.priority(10**12, 10**12, 1)
+
+
+# ------------------------------------------------------------ fee math
+
+
+class TestFeeMath:
+    def test_fee_formula(self):
+        rt = Runtime()
+        cfg = rt.config
+        w = fees_mod.weight_of("oss", "authorize")
+        assert rt.fees.fee_of("oss", "authorize") == \
+            cfg.base_fee + w * cfg.fee_per_weight
+
+    def test_charge_and_exact_split(self):
+        rt = Runtime(RuntimeConfig(endowed={"user": 10 * TOKEN}))
+        fee = rt.fees.fee_of("oss", "authorize")
+        charged = rt.fees.charge("user", "oss", "authorize", tip=7)
+        assert charged == fee + 7
+        assert rt.state.balances.free("user") == 10 * TOKEN - charged
+        to_treasury, to_author = rt.fees.distribute("auth")
+        # floor split: treasury gets exactly ⌊20%⌋, author the rest
+        assert to_treasury == charged * 20 // 100
+        assert to_author == charged - to_treasury
+        assert rt.state.balances.free(TREASURY_POT) == to_treasury
+        assert rt.state.balances.free("auth") == to_author
+        assert rt.state.balances.free(fees_mod.FEE_POT) == 0
+        assert rt.fees.block_fees == 0
+        assert rt.fees.paid_author == {"auth": to_author}
+        assert rt.fees.paid_treasury == to_treasury
+
+    def test_charge_rejects_broke_and_negative(self):
+        rt = Runtime(RuntimeConfig(endowed={"poor": 5}))
+        with pytest.raises(DispatchError):
+            rt.fees.charge("poor", "oss", "authorize")
+        with pytest.raises(DispatchError, match="NegativeTip"):
+            rt.fees.charge("poor", "oss", "authorize", tip=-1)
+
+    def test_operational_charge_is_zero(self):
+        rt = Runtime(RuntimeConfig(endowed={"v": TOKEN}))
+        assert rt.fees.charge("v", "offences", "heartbeat") == 0
+        assert rt.state.balances.free("v") == TOKEN
+
+
+# ------------------------------------------------------------ pool units
+
+
+class TestTxPool:
+    def test_select_orders_by_priority(self):
+        pool = TxPool()
+        pool.submit(entry("a", 0, 10), 0)
+        pool.submit(entry("b", 0, 30), 0)
+        pool.submit(entry("c", 0, 20), 0)
+        out = pool.select(10, 10**9, {})
+        assert [e.ext.signer for e in out] == ["b", "c", "a"]
+        assert len(pool) == 0
+
+    def test_select_keeps_account_nonces_contiguous(self):
+        pool = TxPool()
+        pool.submit(entry("a", 0, 10), 0)
+        pool.submit(entry("a", 1, 500), 0)  # can't jump the queue
+        pool.submit(entry("b", 0, 100), 0)
+        out = pool.select(10, 10**9, {})
+        assert [(e.ext.signer, e.ext.nonce) for e in out] == [
+            ("b", 0), ("a", 0), ("a", 1)]
+
+    def test_select_respects_weight_limit(self):
+        pool = TxPool()
+        pool.submit(entry("a", 0, 100, weight=150), 0)
+        pool.submit(entry("b", 0, 50, weight=100), 0)
+        out = pool.select(10, 200, {})
+        # a's head fits; its would-be second tx doesn't exist, b's 100
+        # would overflow 200 after a's 150 → only a selected... unless
+        # b fits first: a (p=100, w=150) selected, then b (w=100)
+        # overflows and blocks
+        assert [(e.ext.signer) for e in out] == ["a"]
+        assert pool.has("b", 0)
+
+    def test_overweight_head_blocks_account_not_pool(self):
+        pool = TxPool()
+        pool.submit(entry("a", 0, 100, weight=900), 0)
+        pool.submit(entry("b", 0, 10, weight=50), 0)
+        out = pool.select(10, 100, {})
+        # a's head can never fit; b still gets in
+        assert [e.ext.signer for e in out] == ["b"]
+
+    def test_fee_bump_replacement(self):
+        pool = TxPool()
+        pool.submit(entry("a", 0, 100), 0)
+        with pytest.raises(FeeTooLow, match="replacement underpriced"):
+            pool.submit(entry("a", 0, 109), 0)  # <10% bump
+        assert pool.submit(entry("a", 0, 110), 0) == []
+        assert len(pool) == 1
+        out = pool.select(10, 10**9, {})
+        assert out[0].priority == 110
+
+    def test_duplicate_hash_rejected(self):
+        pool = TxPool()
+        e = entry("a", 0, 10)
+        pool.submit(e, 0)
+        dup = entry("a", 1, 10)
+        dup.hash = e.hash
+        with pytest.raises(ValueError, match="duplicate"):
+            pool.submit(dup, 0)
+
+    def test_future_band(self):
+        pool = TxPool(future_band=4)
+        pool.submit(entry("a", 0, 10), 0)
+        pool.submit(entry("a", 5, 10), 0)  # within 1 + 4
+        with pytest.raises(ValueError, match="future"):
+            pool.submit(entry("a", 6, 10), 0)
+        st = pool.stats({"a": 0})
+        assert st == {"count": 2, "bytes": 200, "pending": 1, "future": 1}
+        # filling the gap promotes the future tx into the pending band
+        for n in (1, 2, 3, 4):
+            pool.submit(entry("a", n, 10), 0)
+        assert pool.stats({"a": 0})["pending"] == 6
+
+    def test_per_account_cap_evicts_tail_for_earlier_nonce(self):
+        pool = TxPool(per_account=4)
+        for n in (0, 1, 3, 4):
+            pool.submit(entry("a", n, 10), 0)
+        with pytest.raises(PoolFull, match="already has 4"):
+            pool.submit(entry("a", 5, 10), 0)
+        # an earlier-slot tx evicts the tail instead (band contiguity)
+        victims = pool.submit(entry("a", 2, 10), 0)
+        assert [v.ext.nonce for v in victims] == [4]
+        assert pool.has("a", 2) and not pool.has("a", 4)
+        assert pool.evictions == 1
+
+    def test_global_bound_displaces_lowest_priority_tail(self):
+        pool = TxPool(max_count=2)
+        pool.submit(entry("a", 0, 10), 0)
+        pool.submit(entry("b", 0, 20), 0)
+        victims = pool.submit(entry("c", 0, 30), 0)
+        assert [v.ext.signer for v in victims] == ["a"]
+        with pytest.raises(PoolFull, match="too low to displace"):
+            pool.submit(entry("d", 0, 5), 0)
+        # equal priority does not displace (strict inequality)
+        with pytest.raises(PoolFull):
+            pool.submit(entry("d", 0, 20), 0)
+
+    def test_byte_bound(self):
+        pool = TxPool(max_bytes=250)
+        pool.submit(entry("a", 0, 10, size=100), 0)
+        pool.submit(entry("b", 0, 20, size=100), 0)
+        victims = pool.submit(entry("c", 0, 30, size=100), 0)
+        assert [v.ext.signer for v in victims] == ["a"]
+        assert pool.bytes() <= 250
+
+    def test_never_evicts_own_tail(self):
+        pool = TxPool(max_count=1)
+        pool.submit(entry("a", 0, 10), 0)
+        # even at far higher priority, a's own tail is not evictable —
+        # that could gap the very band being extended
+        with pytest.raises(PoolFull):
+            pool.submit(entry("a", 1, 10_000), 0)
+
+    def test_prune_by_hash_and_stale_nonce(self):
+        pool = TxPool()
+        e0, e1 = entry("a", 0, 10), entry("a", 1, 10)
+        pool.submit(e0, 0)
+        pool.submit(e1, 0)
+        pool.submit(entry("b", 0, 10), 0)
+        pool.prune({e0.hash}, {"a": 1})
+        assert not pool.has("a", 0) and pool.has("a", 1)
+        pool.prune(set(), {"a": 2, "b": 1})
+        assert len(pool) == 0
+
+    def test_requeue_skips_stale_and_occupied(self):
+        pool = TxPool()
+        replacement = entry("a", 1, 500)
+        pool.submit(replacement, 1)
+        pool.requeue([entry("a", 0, 10), entry("a", 1, 10),
+                      entry("b", 0, 10)], {"a": 1, "b": 0})
+        assert not pool.has("a", 0)          # stale vs base
+        assert pool.has("b", 0)
+        out = pool.select(10, 10**9, {"a": 1, "b": 0})
+        # the pooled replacement kept its slot over the requeued one
+        assert replacement in out
+
+    def test_displaces_multiple_victims_from_one_account(self):
+        # one submit may need several evictions; after an account's
+        # tail is chosen the NEXT-highest nonce becomes its effective
+        # tail (the first is being dropped in the same operation), so
+        # deep displacement from a single spammer works
+        pool = TxPool(max_count=3, max_bytes=350)
+        for n in range(3):
+            pool.submit(entry("spam", n, 10, size=100), 0)
+        victims = pool.submit(entry("payer", 0, 1000, size=250), 0)
+        # the byte bound forced two spam evictions, tail-first
+        assert [v.ext.nonce for v in victims] == [2, 1]
+        assert pool.has("spam", 0) and not pool.has("spam", 1)
+        assert pool.has("payer", 0)
+
+    def test_requeue_reimposes_caps(self):
+        # a reorg retraction must not inflate the pool past its memory
+        # bound: requeue sheds lowest-priority tails and reports them
+        pool = TxPool(max_count=2)
+        pool.submit(entry("a", 0, 50), 0)
+        pool.submit(entry("b", 0, 40), 0)
+        shed = pool.requeue(
+            [entry("c", 0, 10), entry("c", 1, 10), entry("d", 0, 30)],
+            {},
+        )
+        assert len(pool) == 2
+        assert pool.evictions == 3
+        # lowest-priority tails went first: both of c's, then d's
+        assert {(v.ext.signer, v.ext.nonce) for v in shed} == {
+            ("c", 0), ("c", 1), ("d", 0)}
+        assert pool.has("a", 0) and pool.has("b", 0)
+
+
+# ------------------------------------------------------------ intake
+
+
+class TestServiceIntake:
+    def test_fee_charged_and_split_exactly(self):
+        s = make_service()
+        s.submit_extrinsic(signed(s, "bob", "oss", "authorize", "alice",
+                                  tip=13))
+        rec = s.produce_block()
+        r = rec.receipts[0]
+        assert r["ok"] and r["fee"] == s.rt.fees.fee_of(
+            "oss", "authorize") + 13
+        to_t = r["fee"] * 20 // 100
+        assert s.rt.state.balances.free(TREASURY_POT) == to_t
+        assert s.rt.fees.paid_author == {"alice": r["fee"] - to_t}
+        # validator economics: alice endowed 1M, genesis bond reserves
+        # 10k → free is exactly 990k + her author cut
+        assert s.rt.state.balances.free("alice") == \
+            990_000 * TOKEN + r["fee"] - to_t
+
+    def test_negative_tip_rejected_at_intake(self):
+        s = make_service()
+        with pytest.raises(ValueError, match="negative tip"):
+            s.submit_extrinsic(
+                signed(s, "bob", "oss", "authorize", "alice", tip=-1))
+
+    def test_broke_account_gets_fee_too_low(self):
+        spec = dev_spec()
+        spec.accounts["broke"] = {
+            "balance": 5,
+            "pub": bls.sk_to_pk(dev_sk("broke", "dev")).hex(),
+        }
+        s = NodeService(spec, registry=scoped_registry())
+        with pytest.raises(FeeTooLow, match="cannot pay"):
+            s.submit_extrinsic(signed(s, "broke", "oss", "authorize",
+                                      "alice"))
+
+    def test_dedupe_before_pairing(self, monkeypatch):
+        s = make_service()
+        from cess_tpu.node import service as service_mod
+
+        calls = {"n": 0}
+        real = service_mod.bls.verify
+
+        def counting(*a, **kw):
+            calls["n"] += 1
+            return real(*a, **kw)
+
+        monkeypatch.setattr(service_mod.bls, "verify", counting)
+        ext = signed(s, "bob", "oss", "authorize", "alice")
+        h = s.submit_extrinsic(ext)
+        assert calls["n"] == 1
+        # redelivered duplicate: idempotent, and NO second pairing
+        assert s.submit_extrinsic(ext) == h
+        assert calls["n"] == 1
+        assert len(s.pool) == 1
+
+    def test_bad_signature_cached_before_pairing(self, monkeypatch):
+        s = make_service()
+        from cess_tpu.node import service as service_mod
+
+        calls = {"n": 0}
+        real = service_mod.bls.verify
+
+        def counting(*a, **kw):
+            calls["n"] += 1
+            return real(*a, **kw)
+
+        monkeypatch.setattr(service_mod.bls, "verify", counting)
+        ext = signed(s, "bob", "oss", "authorize", "alice",
+                     sk=dev_sk("charlie"))
+        with pytest.raises(ValueError, match="bad signature"):
+            s.submit_extrinsic(ext)
+        assert calls["n"] == 1
+        with pytest.raises(ValueError, match="bad signature"):
+            s.submit_extrinsic(ext)  # served from the rejection cache
+        assert calls["n"] == 1
+
+    def test_eviction_rolls_back_high_water(self):
+        s = make_service(pool_max_count=2)
+        s.submit_extrinsic(signed(s, "bob", "oss", "authorize", "alice"))
+        s.submit_extrinsic(signed(s, "bob", "oss", "authorize",
+                                  "charlie"))
+        assert s.nonces["bob"] == 2
+        # a paying tx displaces bob's tail; author_nonce must hand the
+        # freed slot back out
+        s.submit_extrinsic(signed(s, "charlie", "oss", "authorize",
+                                  "alice", tip=10 * TOKEN))
+        assert len(s.pool) == 2
+        assert s.nonces["bob"] == 1
+
+    def test_fee_bump_through_intake(self):
+        s = make_service()
+        s.submit_extrinsic(signed(s, "bob", "oss", "authorize", "alice"))
+        with pytest.raises(FeeTooLow):
+            s.submit_extrinsic(signed(s, "bob", "oss", "authorize",
+                                      "alice", nonce=0, tip=1))
+        s.submit_extrinsic(signed(s, "bob", "oss", "authorize", "alice",
+                                  nonce=0, tip=TOKEN))
+        assert len(s.pool) == 1
+        rec = s.produce_block()
+        assert rec.receipts[0]["fee"] == s.rt.fees.fee_of(
+            "oss", "authorize") + TOKEN
+
+    def test_reset_chain_index_keeps_pool_and_cache(self, monkeypatch):
+        s = make_service()
+        # a permanently-bad payload enters the rejection cache
+        bad = signed(s, "bob", "oss", "authorize", "x",
+                     sk=dev_sk("charlie"))
+        with pytest.raises(ValueError):
+            s.submit_extrinsic(bad)
+        s.submit_extrinsic(signed(s, "bob", "oss", "authorize", "alice"))
+        s.produce_block()
+        # a future tx pooled beyond the current chain nonce
+        s.submit_extrinsic(signed(s, "bob", "oss", "cancel_authorize",
+                                  "alice"))
+        blob = s.export_state()
+        s.import_state(blob)  # warp-style restore + index reset
+        # pooled future tx survived with a correct high-water mark
+        assert s.pool.has("bob", 1)
+        assert s.nonces["bob"] == 2
+        assert s.rt.state.nonces["bob"] == 1
+        # the fee-rejected payload is NOT resurrected: still refused,
+        # with no fresh pairing
+        from cess_tpu.node import service as service_mod
+
+        monkeypatch.setattr(
+            service_mod.bls, "verify",
+            lambda *a, **kw: pytest.fail("cached rejection re-paired"))
+        with pytest.raises(ValueError, match="bad signature"):
+            s.submit_extrinsic(bad)
+
+
+# ------------------------------------------------------------ lockstep
+
+
+def make_pair():
+    spec = local_spec()
+    a = NodeService(spec, authority=spec.validators[0],
+                    registry=scoped_registry())
+    b = NodeService(spec, authority=spec.validators[1],
+                    registry=scoped_registry())
+    return spec, a, b
+
+
+def author_block(a):
+    rec, slot = None, a.slot
+    while rec is None:
+        slot += 1
+        rec = a.produce_block(slot=slot)
+    return rec
+
+
+class TestLockstep:
+    def test_deterministic_fees_across_replicas(self):
+        spec, a, b = make_pair()
+        for who, tip in (("dave", 0), ("eve", 17), ("dave", 3)):
+            ext = signed(a, who, "oss", "authorize", "alice", tip=tip,
+                         nonce=a.nonces.get(who, 0), chain=spec.chain_id)
+            a.submit_extrinsic(ext)
+        rec = author_block(a)
+        assert all(r["ok"] for r in rec.receipts)
+        blk = a.block_store[a.head_hash]
+        assert b.handle_announce(blk.to_json()) == "imported"
+        # bit-identical fee state and split on both replicas
+        assert a.state_hash() == b.state_hash()
+        assert a.rt.fees.total_fees == b.rt.fees.total_fees > 0
+        assert a.rt.fees.paid_author == b.rt.fees.paid_author
+        assert a.rt.fees.paid_treasury == b.rt.fees.paid_treasury
+        total = a.rt.fees.total_fees
+        assert a.rt.fees.paid_treasury == total * 20 // 100
+        assert a.rt.state.balances.free(TREASURY_POT) == \
+            b.rt.state.balances.free(TREASURY_POT) == total * 20 // 100
+
+    def test_overweight_block_rejected_at_import(self):
+        spec, a, b = make_pair()
+        # adversarial author: raised local limit lets it stuff a block
+        # past the consensus weight budget
+        a.rt.fees.block_weight_limit = 10**9
+        w = fees_mod.weight_of("evm", "transact_create")
+        need = b.rt.fees.block_weight_limit // w + 1
+        signers = ["alice", "bob", "charlie", "dave", "eve"]
+        per = need // len(signers) + 1
+        for who in signers:
+            for n in range(per):
+                a.submit_extrinsic(signed(
+                    a, who, "evm", "transact_create", "60016000f3",
+                    nonce=n, chain=spec.chain_id), _verified=True)
+        rec = author_block(a)
+        assert len(rec.extrinsics) >= need
+        blk = a.block_store[a.head_hash]
+        from cess_tpu.node.service import BlockImportError
+
+        with pytest.raises(BlockImportError, match="overweight"):
+            b.import_block(blk)
+
+    def test_negative_tip_block_rejected_at_import(self):
+        spec, a, b = make_pair()
+        # a colluding author bypasses intake and pools a negative-tip
+        # extrinsic directly
+        ext = signed(a, "dave", "oss", "authorize", "alice", tip=-7,
+                     nonce=0, chain=spec.chain_id)
+        a.pool.submit(a._pool_entry(ext, ext.hash(a.genesis)), 0)
+        author_block(a)
+        blk = a.block_store[a.head_hash]
+        from cess_tpu.node.service import BlockImportError
+
+        with pytest.raises(BlockImportError, match="negative tip"):
+            b.import_block(blk)

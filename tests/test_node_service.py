@@ -76,8 +76,18 @@ class TestServiceDispatch:
 
     def test_bad_nonce_rejected(self):
         s = make_service()
-        ext = signed(s, "alice", "storage_handler", "buy_space", 1, nonce=5)
+        # beyond the future band (chain nonce 0 + band 8)
+        ext = signed(s, "alice", "storage_handler", "buy_space", 1, nonce=50)
         with pytest.raises(ValueError, match="nonce"):
+            s.submit_extrinsic(ext)
+
+    def test_stale_nonce_rejected(self):
+        s = make_service()
+        s.submit_extrinsic(signed(s, "alice", "oss", "register",
+                                  {"hex": "aa" * 38}, {"hex": ""}))
+        s.produce_block()
+        ext = signed(s, "alice", "oss", "destroy", nonce=0)
+        with pytest.raises(ValueError, match="stale nonce"):
             s.submit_extrinsic(ext)
 
     def test_unknown_call_rejected(self):

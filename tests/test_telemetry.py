@@ -288,7 +288,7 @@ class TestChainEvents:
         spec, a, _ = make_pair()
         author_block_with_extrinsic(spec, a)
         version, data = checkpoint.decode_blob(a.export_state())
-        assert version == checkpoint.FORMAT_VERSION == 5
+        assert version == checkpoint.FORMAT_VERSION == 6
         data["state"]["events"] = [Event.of("legacy", "E", i=1)]
         out = []
         checkpoint._canon(data, out)

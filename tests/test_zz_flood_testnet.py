@@ -1,0 +1,417 @@
+"""Flood soak: a CLI-launched 3-process testnet where one node runs the
+"flood" chaos profile — six dev-seeded spam accounts round-robin
+underpriced `oss.authorize` calls through its intake at ~8/s (≥10× the
+paying rate) on top of the light network faults — and the fee market
+must hold the line:
+
+  * paying (tipped) traffic submitted to the FLOODED node lands within
+    2 slots ≥90% of the time — the fee auction, not arrival order,
+    decides inclusion,
+  * the flooded node's pool stays byte/count bounded: spam is evicted
+    by higher-priority arrivals and rejected with typed backpressure
+    once full (evictions and rejections both observed, pool bytes
+    never exceed the CLI cap),
+  * a full audit round (challenge → prove → verify → reward) and an
+    epoch rotation complete under fire — operational calls ride the
+    priority boost, heavier paid calls route via an unflooded peer,
+  * every author's balance grows by EXACTLY its 20/80 fee split
+    (free == endowment - genesis bond + paid_author), and the
+    treasury's free balance equals the recorded treasury cut,
+  * the fleet converges to ONE finalized state hash.
+
+Spam accounts are endowed with ~40 affordable fees each, so the flood
+burns itself broke mid-soak and the intake's cheap can-pay check (run
+BEFORE the expensive pairing) keeps rejecting the corpses for free.
+
+Sorts last (zz) so a tier-1 timeout truncates it, not the broad suite."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from cess_tpu.node.chain_spec import _spec
+from cess_tpu.node.client import MinerClient, SigningClient, TeeClient
+from cess_tpu.node.rpc import RpcError, rpc_call
+from cess_tpu.chain.types import TOKEN
+from cess_tpu.ops.podr2 import Podr2Params
+
+pytestmark = pytest.mark.fees
+
+PARAMS = Podr2Params(n=8, s=4)
+# slower slots than the chaos soak: the inclusion-latency assertion
+# below needs a slot comfortably wider than one host BLS pairing
+# (~0.3s of GIL-bound work on the shared-core CI machine)
+BLOCK_MS = 1600
+HOST = "127.0.0.1"
+CHAOS_SEED = 20260805
+VALIDATORS = ["alice", "bob", "charlie"]
+FLOODED = "alice"            # runs --chaos-profile flood (spam driver)
+SPAM = [f"spam-{i}" for i in range(6)]
+# oss.authorize: weight 50 → fee = 1e9 base + 50·1e7 = 1.5e9; endow
+# each spammer ~40 fees so the flood lasts ~30s then goes broke
+SPAM_BALANCE = 40 * 1_500_000_000
+# hard bounds on the flooded node's pool: small enough that the ~6
+# spam arrivals per 800ms slot keep it full between drains
+POOL_MAX_COUNT = 6
+POOL_MAX_BYTES = 8192
+PAID_TXS = 10
+PAID_TIP = 1 * TOKEN         # ≫ spam priority: tipped traffic must win
+
+
+def free_ports(n: int) -> list[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind((HOST, 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def build_spec_file(tmp_path) -> str:
+    spec = _spec(
+        "flood", "CESS-TPU Flood Soak",
+        accounts=["alice", "bob", "charlie", "dave", "eve", "miner-0",
+                  "tee-stash", "tee-ctrl", *SPAM],
+        validators=VALIDATORS,
+        block_time_ms=BLOCK_MS,
+    )
+    for name in SPAM:
+        spec.accounts[name]["balance"] = SPAM_BALANCE
+    spec.finality_period = 4
+    spec.genesis = {
+        "one_day_block": 20,       # ~50% challenge trigger per block
+        "podr2_chunk_count": PARAMS.n,
+        "era_duration_blocks": 8,
+        # ONE 8-block session per era: wide heartbeat window, so no
+        # honest validator gets chilled (the exact-balance assertions
+        # need a slash-free run)
+        "sessions_per_era": 1,
+        "genesis_candidates": VALIDATORS,
+    }
+    path = tmp_path / "flood-spec.json"
+    path.write_text(spec.to_json())
+    return str(path)
+
+
+def launch(spec_path: str, authority: str, port: int,
+           peer_ports: list[int]) -> subprocess.Popen:
+    peers = ",".join(f"{HOST}:{p}" for p in peer_ports)
+    args = [
+        sys.executable, "-m", "cess_tpu", "run",
+        "--chain", spec_path, "--rpc-port", str(port),
+        "--authority", authority, "--peers", peers,
+        "--checkpoint-gap", "24",
+        "--chaos-seed", str(CHAOS_SEED),
+    ]
+    if authority == FLOODED:
+        # the spam driver + tight pool bounds live on ONE node: spam
+        # still reaches peers via gossip, but their default-sized
+        # pools absorb it while the flooded node must evict
+        args += ["--chaos-profile", "flood",
+                 "--pool-max-count", str(POOL_MAX_COUNT),
+                 "--pool-max-bytes", str(POOL_MAX_BYTES)]
+    else:
+        args += ["--chaos-profile", "light"]
+    log = open(f"/tmp/flood-{authority}.log", "w")
+    return subprocess.Popen(
+        args, stdout=log, stderr=subprocess.STDOUT,
+        cwd="/root/repo", text=True,
+    )
+
+
+def wait_rpc(port: int, timeout: float = 120.0) -> None:
+    t0 = time.monotonic()
+    while True:
+        try:
+            rpc_call(HOST, port, "system_name", [], timeout=2.0)
+            return
+        except (OSError, RpcError):
+            if time.monotonic() - t0 > timeout:
+                raise TimeoutError(f"node on port {port} never came up")
+            time.sleep(0.5)
+
+
+def status(port: int) -> dict:
+    return rpc_call(HOST, port, "sync_status", [], timeout=5.0)
+
+
+def wait_for(pred, timeout: float, what: str, poll: float = 0.5):
+    t0 = time.monotonic()
+    while True:
+        try:
+            value = pred()
+        except (OSError, RpcError, ValueError):
+            value = None
+        if value:
+            return value
+        if time.monotonic() - t0 > timeout:
+            raise TimeoutError(f"timed out waiting for {what}")
+        time.sleep(poll)
+
+
+class TestFloodSoak:
+    def test_spam_flood_soak(self, tmp_path):
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from tools.telemetry_report import FleetCollector, to_markdown
+
+        spec_path = build_spec_file(tmp_path)
+        ports = free_ports(3)
+        procs = {}
+        try:
+            for v, port in zip(VALIDATORS, ports):
+                procs[v] = launch(
+                    spec_path, v, port, [p for p in ports if p != port]
+                )
+            for port in ports:
+                wait_rpc(port)
+            port0, port1 = ports[0], ports[1]
+            collector = FleetCollector([(HOST, p) for p in ports])
+            soak_t0 = time.time()
+
+            # ---- liveness: every node advances while spam hammers
+            # the flooded node's intake from second one
+            wait_for(
+                lambda: min(status(p)["number"] for p in ports) >= 2,
+                150, "all nodes past block 2",
+            )
+            collector.sample()
+
+            # ---- the fee auction under fire: tipped traffic submitted
+            # to the FLOODED node must land within 2 slots ≥90% of the
+            # time while the spam flood is still alive and outnumbering
+            # it ~10:1.  Pool byte usage is sampled throughout and must
+            # never exceed the CLI cap.
+            payers = [
+                SigningClient("dave", chain_id="flood", port=port0,
+                              timeout=30.0),
+                SigningClient("eve", chain_id="flood", port=port0,
+                              timeout=30.0),
+            ]
+            max_pool_bytes = 0
+            included_fast = 0
+            for i in range(PAID_TXS):
+                payer = payers[i % 2]
+                before = rpc_call(
+                    HOST, port0, "chain_accountNonce", [payer.account],
+                    timeout=5.0)
+                try:
+                    payer.submit("oss", "authorize", "dave",
+                                 tip=PAID_TIP)
+                except RpcError:
+                    # a refused paid submission counts against the
+                    # inclusion bar below, not as a harness crash
+                    continue
+                # clock starts at ADMISSION: submit returns once the
+                # tx passed the flooded node's auction and gossip is
+                # in flight — the ~0.3s pairing before that is signer
+                # verification latency, not fee-market latency
+                head = status(port0)["number"]
+                deadline = time.monotonic() + 10.0
+                landed_at = None
+                while time.monotonic() < deadline:
+                    st = rpc_call(HOST, port0, "author_poolStatus", [],
+                                  timeout=5.0)
+                    max_pool_bytes = max(max_pool_bytes, st["bytes"])
+                    assert st["bytes"] <= POOL_MAX_BYTES
+                    nonce = rpc_call(
+                        HOST, port0, "chain_accountNonce",
+                        [payer.account], timeout=5.0)
+                    if nonce > before:
+                        landed_at = status(port0)["number"]
+                        break
+                    time.sleep(0.05)
+                if landed_at is not None and landed_at - head <= 2:
+                    included_fast += 1
+            assert included_fast >= int(PAID_TXS * 0.9), (
+                f"paying traffic starved: only {included_fast}/"
+                f"{PAID_TXS} landed within 2 slots"
+            )
+            collector.sample()
+
+            # ---- audit round under fire: the miner/tee clients talk
+            # to an UNFLOODED peer — their heavy untipped calls (lower
+            # fee-per-weight than the spam) would bounce off the
+            # flooded node's full pool, which is the fee market doing
+            # its job, not a soak failure.  Consensus still includes
+            # them via the peer's blocks and the flooded node imports.
+            tee = TeeClient("tee-ctrl", chain_id="flood", port=port1,
+                            timeout=60.0)
+            stash = TeeClient("tee-stash", chain_id="flood", port=port1,
+                              timeout=60.0)
+            miner = MinerClient("miner-0", chain_id="flood", port=port1,
+                                timeout=60.0)
+            stash.submit("staking", "bond", "tee-ctrl", 100_000 * TOKEN)
+            tee.register("tee-stash")
+            wait_for(
+                lambda: rpc_call(HOST, port1, "teeWorker_podr2Key", [],
+                                 timeout=5.0) is not None,
+                180, "tee registration on chain",
+            )
+            miner.register("miner-0-ben", b"peer", 8000 * TOKEN)
+            miner.create_fillers(tee, 2, PARAMS)
+
+            def has_idle_space():
+                try:
+                    return miner.info()["idle_space"] > 0
+                except RpcError:
+                    return False
+
+            wait_for(has_idle_space, 180, "filler report on chain")
+            collector.sample()
+
+            def challenged():
+                snap = miner.call("audit_challengeSnapshot")
+                return snap is not None and any(
+                    s["miner"] == "miner-0"
+                    for s in snap["miner_snapshot_list"]
+                )
+
+            wait_for(challenged, 420, "OCW-driven challenge commit")
+
+            from cess_tpu.proof import CpuBackend
+
+            backend = CpuBackend()
+            items = miner.answer_challenge(backend, PARAMS)
+            assert items is not None
+            results = wait_for(
+                lambda: tee.verify_missions(
+                    backend, PARAMS, {"miner-0": items}),
+                300, "verify mission assigned",
+            )
+            assert results == {"miner-0": (True, True)}
+            reward = wait_for(
+                lambda: (miner.call("sminer_rewardInfo", "miner-0")
+                         or {}).get("currently_available_reward", 0),
+                180, "audit reward order",
+            )
+            assert reward > 0
+            collector.sample()
+
+            # ---- epoch rotation happened under flood
+            wait_for(
+                lambda: all(
+                    rpc_call(HOST, p, "rrsc_epochInfo", [],
+                             timeout=5.0)["epochIndex"] >= 1
+                    for p in ports
+                ),
+                120, "epoch rotation on every node",
+            )
+
+            # ---- pool memory stayed bounded and the bound BITES:
+            # spam was evicted by higher-priority arrivals and rejected
+            # with typed backpressure once full
+            st = rpc_call(HOST, port0, "author_poolStatus", [],
+                          timeout=5.0)
+            assert st["maxCount"] == POOL_MAX_COUNT
+            assert st["maxBytes"] == POOL_MAX_BYTES
+            assert st["bytes"] <= POOL_MAX_BYTES
+            assert max_pool_bytes <= POOL_MAX_BYTES
+            assert st["evictions"] > 0, "no spam was ever evicted"
+            health = rpc_call(HOST, port0, "system_health", [],
+                              timeout=5.0)
+            assert set(health["txPoolSize"]) == {"pending", "future"}
+
+            # ---- exact fee conservation: each author's free balance
+            # is its endowment minus the genesis bond plus EXACTLY its
+            # recorded 80% cut; the treasury's free balance is exactly
+            # the recorded 20% cut (the spec has no slashes: every
+            # validator heartbeats, nobody equivocates, proofs verify).
+            # Spam burned itself broke mid-soak and heartbeats are
+            # free, so the totals quiesce once paid traffic stops.
+            def fees_settled():
+                f = rpc_call(HOST, port0, "fees_state", [], timeout=5.0)
+                paid = f["paidAuthor"]
+                if f["paidTreasury"] + sum(paid.values()) != \
+                        f["totalFees"]:
+                    return None
+                if f["treasuryFree"] != f["paidTreasury"]:
+                    return None
+                for v in VALIDATORS:
+                    free = rpc_call(HOST, port0, "balances_free", [v],
+                                    timeout=5.0)
+                    if free != 990_000 * TOKEN + paid.get(v, 0):
+                        return None
+                return f
+
+            fee_state = wait_for(
+                fees_settled, 60,
+                "author balances == endowment - bond + 20/80 fee cut",
+            )
+            assert fee_state["totalFees"] > 0
+            # the flood paid for what little of it landed: every spam
+            # account was charged at least one fee (how broke they get
+            # depends on how much backpressure throttled them — the
+            # intake's cheap can-pay check takes over once they drain)
+            for name in SPAM:
+                free = rpc_call(HOST, port0, "balances_free", [name],
+                                timeout=5.0)
+                assert free < SPAM_BALANCE
+
+            # ---- convergence: one finalized state hash everywhere
+            fin = wait_for(
+                lambda: min(
+                    status(p)["finalized"]["number"] for p in ports
+                ),
+                180, "finalized head on every node",
+            )
+            assert fin >= 4
+
+            def converged():
+                try:
+                    blocks = [
+                        rpc_call(HOST, p, "sync_block", [fin],
+                                 timeout=5.0)
+                        for p in ports
+                    ]
+                except RpcError:
+                    return None
+                hashes = {b["block"]["stateHash"] for b in blocks}
+                return hashes if len(hashes) == 1 else None
+
+            assert wait_for(converged, 90, "one finalized state hash")
+
+            # ---- the soak ends with a committed telemetry report:
+            # the fleet roll-up must show the spam being shed
+            for _ in range(3):
+                collector.sample()
+                time.sleep(0.5)
+            report = collector.report(elapsed_s=time.time() - soak_t0)
+            fleet = report["fleet"]
+            assert fleet["blocks_per_s"] > 0
+            assert fleet["pool_rejections_total"] > 0, \
+                "the flooded node never pushed back on spam"
+            assert fleet["pool_evictions_total"] > 0
+            assert fleet["spam_drop_rate"] > 0
+            root = os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))
+            with open(os.path.join(root, "FLOOD_TELEMETRY.json"),
+                      "w") as fh:
+                fh.write(json.dumps(report, indent=2, sort_keys=True)
+                         + "\n")
+            with open(os.path.join(root, "FLOOD_TELEMETRY.md"),
+                      "w") as fh:
+                fh.write(to_markdown(report) + "\n")
+
+            for payer in payers:
+                payer.close()
+            miner.close()
+            tee.close()
+            stash.close()
+        finally:
+            for proc in procs.values():
+                if proc.poll() is None:
+                    proc.kill()
+            for proc in procs.values():
+                try:
+                    proc.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    pass

@@ -210,6 +210,27 @@ class FleetCollector:
                     for stage in self.IMPORT_STAGES
                     if (name := f"cess_import_{stage}_seconds") in fams
                 }
+                # tx-pool families (fee market, node/service.py): the
+                # rejection counter is labelled by reason — keep both
+                # the per-reason breakdown and the total
+                rej = fams.get("cess_pool_rejections", m.MetricFamily(
+                    "cess_pool_rejections"))
+                entry["pool"] = {
+                    "size": fams.get(
+                        "cess_pool_size", m.MetricFamily("")).value(),
+                    "bytes": fams.get(
+                        "cess_pool_bytes", m.MetricFamily("")).value(),
+                    "evictions": fams.get(
+                        "cess_pool_evictions", m.MetricFamily("")).value(),
+                    "rejections": rej.total(),
+                    "rejectionsByReason": {
+                        labels.get("reason", "?"): v
+                        for sname, labels, v in rej.samples
+                        if sname == rej.name
+                    },
+                    "feeTotal": fams.get(
+                        "cess_pool_fee_total", m.MetricFamily("")).value(),
+                }
             per_node[label] = entry
 
         # fleet rates: the chain advances as one, so blocks/s is the
@@ -282,6 +303,16 @@ class FleetCollector:
                         total / count * 1000.0, 3) if count else 0.0,
                 }
 
+        # fee-market pressure: how much intake the pools turned away
+        # vs how much work the chain actually applied — the spam-drop
+        # rate a flood soak watches alongside paid-traffic inclusion
+        rejections_total = sum(
+            e.get("pool", {}).get("rejections", 0.0)
+            for e in per_node.values()
+        )
+        applied_total = sum(
+            e.get("extrinsicsApplied", 0.0) for e in per_node.values()
+        )
         return {
             "generated_at": time.strftime(
                 "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
@@ -297,6 +328,14 @@ class FleetCollector:
                     sum(e["gossipDropped"].values())
                     for e in per_node.values()
                 ),
+                "pool_rejections_total": rejections_total,
+                "pool_evictions_total": sum(
+                    e.get("pool", {}).get("evictions", 0.0)
+                    for e in per_node.values()
+                ),
+                "spam_drop_rate": round(
+                    rejections_total
+                    / max(1.0, rejections_total + applied_total), 4),
             },
             "per_node": per_node,
             "proof": proof,
@@ -323,6 +362,15 @@ def to_markdown(report: dict) -> str:
         f"| gossip drops (total) | {fleet['gossip_drops_total']} |",
         f"| cross-node stitched traces | {fleet['stitched_traces']} |",
         "",
+        "## Tx pool",
+        "",
+        "| metric | value |",
+        "|---|---|",
+        f"| intake rejections (total) "
+        f"| {fleet.get('pool_rejections_total', 0)} |",
+        f"| evictions (total) | {fleet.get('pool_evictions_total', 0)} |",
+        f"| spam drop rate | {fleet.get('spam_drop_rate', 0)} |",
+        "",
         "## Per node",
         "",
     ]
@@ -341,6 +389,15 @@ def to_markdown(report: dict) -> str:
         drops = entry.get("gossipDropped") or {}
         if drops:
             lines.append(f"- gossip drops: {json.dumps(drops)}")
+        pool = entry.get("pool") or {}
+        if pool:
+            lines.append(
+                f"- pool: {int(pool['size'])} txs / "
+                f"{int(pool['bytes'])} B, "
+                f"{int(pool['evictions'])} evictions, "
+                f"{int(pool['rejections'])} rejections "
+                f"{json.dumps(pool.get('rejectionsByReason', {}))}, "
+                f"fees charged {int(pool['feeTotal'])}")
         stages = entry.get("importStages") or {}
         if stages:
             lines += ["", "| import stage | n | mean ms | p50 ms | p95 ms |",
