@@ -461,6 +461,25 @@ def snapshot_and_hash(rt) -> tuple[bytes, str]:
     return header + payload, hashlib.sha256(payload).hexdigest()
 
 
+def blob_payload_hash(blob: bytes) -> str:
+    """sha256 of a CURRENT-version blob's canonical payload WITHOUT
+    decoding it — the cheap integrity gate the on-disk store
+    (node/store.py) runs before restoring a checkpoint: the value must
+    equal the state_hash the signed head block commits to, so a torn
+    or bit-flipped checkpoint file fails closed before any restore
+    work.  Only meaningful for FORMAT_VERSION blobs (older versions
+    hash differently after migration); anything else raises."""
+    if not blob.startswith(MAGIC):
+        raise ValueError("headerless blob has no comparable payload hash")
+    version = int.from_bytes(blob[len(MAGIC):len(MAGIC) + 2], "big")
+    if version != FORMAT_VERSION:
+        raise ValueError(
+            f"payload hash is version-bound (blob v{version}, "
+            f"build v{FORMAT_VERSION})"
+        )
+    return hashlib.sha256(blob[len(MAGIC) + 2:]).hexdigest()
+
+
 def decode_blob(blob: bytes) -> tuple[int, dict]:
     """Parse a snapshot blob → (version, payload dict), migrations NOT
     yet applied.  Headerless blobs are v1 (the pre-header format)."""

@@ -50,9 +50,6 @@ def _cmd_run(args) -> int:
         pool_max_bytes=args.pool_max_bytes,
     )
     service.chaos_mute = bool(args.chaos_mute)
-    if args.import_state:
-        with open(args.import_state, "rb") as fh:
-            service.import_state(fh.read())
     faults = None
     spam = None
     if args.chaos_seed is not None:
@@ -62,6 +59,24 @@ def _cmd_run(args) -> int:
         profile = PROFILES[args.chaos_profile]
         if profile.flood_accounts > 0:
             spam = SpamDriver(service, profile, seed=args.chaos_seed)
+    store = None
+    if args.data_dir:
+        from .store import BlockStore
+
+        # recovery ladder BEFORE any network plane exists: checkpoint
+        # restore + journal replay need no peers; whatever is still
+        # missing falls to catch-up/warp once the sync loop starts
+        store = BlockStore(args.data_dir, registry=service.registry,
+                           faults=faults)
+        recovered = store.recover(service)
+        print(f"store: data-dir={args.data_dir} "
+              f"rung={recovered['rung']} "
+              f"replayed={recovered['replayed']} "
+              f"truncated={recovered['truncated']} "
+              f"head=#{recovered['head']}", flush=True)
+    if args.import_state:
+        with open(args.import_state, "rb") as fh:
+            service.import_state(fh.read())
     if args.peers:
         SyncManager(
             service, _parse_peers(args.peers),
@@ -102,6 +117,8 @@ def _cmd_run(args) -> int:
         if service.sync is not None:
             service.sync.stop()
         server.stop()
+        if store is not None:
+            store.close()
     print(
         f"stopped at block {service.rt.state.block_number} "
         f"finalized={service.finalized_number} "
@@ -264,6 +281,11 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--block-time-ms", type=int, default=0)
     run.add_argument("--import-state", default=None,
                      help="checkpoint blob to resume from")
+    run.add_argument("--data-dir", default=None,
+                     help="durable on-disk store (node/store.py): "
+                          "write-ahead block journal + atomic "
+                          "checkpoints; on restart the node recovers "
+                          "from disk before touching the network")
     run.add_argument("--peers", default="",
                      help="comma-separated host:port RPC endpoints of "
                           "peer nodes (enables sync + finality gossip)")
@@ -277,9 +299,12 @@ def build_parser() -> argparse.ArgumentParser:
                           "node's outbound gossip + catch-up RPC "
                           "(node/faults.py); same seed, same schedule")
     run.add_argument("--chaos-profile", default="mild",
-                     choices=["off", "light", "mild", "hostile", "flood"],
+                     choices=["off", "light", "mild", "hostile", "flood",
+                              "baddisk"],
                      help="fault-probability profile for --chaos-seed "
-                          "(flood adds synthetic spam-account load)")
+                          "(flood adds synthetic spam-account load; "
+                          "baddisk injects storage faults into "
+                          "--data-dir writes)")
     run.add_argument("--pool-max-count", type=int, default=None,
                      help="hard tx-pool transaction bound (default 2048)")
     run.add_argument("--pool-max-bytes", type=int, default=None,

@@ -26,6 +26,11 @@ This module is that shaper:
    node crashes at which block — harnesses (tests/test_zz_chaos_*)
    kill and relaunch accordingly, so even process death is part of the
    reproducible schedule.
+ * **Storage** (`disk_write_gate` / `disk_read_gate`): the on-disk
+   store (node/store.py) routes its file ops through the injector —
+   seed-deterministic ENOSPC, torn writes, bit flips, and short reads
+   exercise the degraded-mode and recovery-truncation contracts
+   (tests/test_persistence.py).
 
 Enabled per node via `--chaos-seed N [--chaos-profile mild|hostile]`
 (node/cli.py); each node shapes only its own OUTBOUND traffic, so a
@@ -68,6 +73,16 @@ class ChaosProfile:
     flood_accounts: int = 0
     flood_rate: float = 0.0
     flood_tip: int = 0
+    # Storage fault plane (node/store.py wraps its file ops through
+    # disk_write_gate / disk_read_gate): per-operation probabilities of
+    # an injected ENOSPC (raises ChaosError before any byte lands), a
+    # torn write (only a prefix reaches disk but the write "succeeds" —
+    # a lying disk / power-loss model), a flipped bit, and a short
+    # read.  All zero on the network-only profiles.
+    disk_enospc: float = 0.0
+    disk_torn: float = 0.0
+    disk_flip: float = 0.0
+    disk_short_read: float = 0.0
 
 
 PROFILES = {
@@ -92,6 +107,14 @@ PROFILES = {
     "flood": ChaosProfile(
         "flood", drop=0.02, delay=0.05, delay_ms=(5, 40),
         duplicate=0.10, flood_accounts=6, flood_rate=8.0, flood_tip=0,
+    ),
+    # hostile disk under a quiet network: the persistence drills —
+    # intermittent ENOSPC, the occasional torn/bit-flipped write, and
+    # short reads at recovery.  The store must degrade (never crash)
+    # and recovery must truncate (never accept a torn record).
+    "baddisk": ChaosProfile(
+        "baddisk", disk_enospc=0.10, disk_torn=0.05, disk_flip=0.02,
+        disk_short_read=0.05,
     ),
 }
 
@@ -215,6 +238,53 @@ class FaultInjector:
                 self.injected += 1
         if delay:
             time.sleep(delay)
+
+    # ------------------------------------------------------ storage
+
+    def disk_write_gate(self, data: bytes) -> bytes:
+        """Consulted by the store (node/store.py) with the exact bytes
+        about to hit disk: raises ChaosError(ENOSPC) for an injected
+        full disk, returns a truncated prefix for a torn write (the
+        write APPEARS to succeed — the power-loss/lying-disk model the
+        recovery ladder must truncate at), or the buffer with one bit
+        flipped.  Same seed, same fault schedule — the disk draws from
+        its own deterministic stream, independent of the network
+        planes."""
+        with self._lock:
+            rnd = self._stream(("disk", "w"))
+            prof = self.profile
+            if rnd.random() < prof.disk_enospc:
+                self.injected += 1
+                raise ChaosError(28, "chaos: injected ENOSPC")
+            if data and rnd.random() < prof.disk_torn:
+                self.injected += 1
+                return data[:rnd.randrange(len(data))]
+            if data and rnd.random() < prof.disk_flip:
+                self.injected += 1
+                i = rnd.randrange(len(data))
+                return (data[:i]
+                        + bytes([data[i] ^ (1 << rnd.randrange(8))])
+                        + data[i + 1:])
+            return data
+
+    def disk_read_gate(self, data: bytes) -> bytes:
+        """Consulted on store reads (journal scan, checkpoint load):
+        returns a short read or a bit-flipped buffer — recovery must
+        treat both as a torn tail / invalid checkpoint, never accept
+        them."""
+        with self._lock:
+            rnd = self._stream(("disk", "r"))
+            prof = self.profile
+            if data and rnd.random() < prof.disk_short_read:
+                self.injected += 1
+                return data[:rnd.randrange(len(data))]
+            if data and rnd.random() < prof.disk_flip:
+                self.injected += 1
+                i = rnd.randrange(len(data))
+                return (data[:i]
+                        + bytes([data[i] ^ (1 << rnd.randrange(8))])
+                        + data[i + 1:])
+            return data
 
 
 class SpamDriver:

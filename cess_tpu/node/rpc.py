@@ -88,6 +88,15 @@ class RpcApi:
                     "pending": pool["pending"], "future": pool["future"],
                 },
                 "bestBlock": best,
+                # durable-store health (node/store.py): True while the
+                # last journal/checkpoint write hit an OSError (ENOSPC,
+                # injected storage fault) and the node is running from
+                # memory; clears on the next successful append.  False
+                # when no --data-dir store is attached.
+                "storageDegraded": (
+                    bool(s.store.degraded) if s.store is not None
+                    else False
+                ),
                 # finality lag: the observable the GRANDPA
                 # accountable-safety drills need (PAPERS.md) — a node
                 # whose lag grows while bestBlock advances is cut off

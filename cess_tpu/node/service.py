@@ -738,6 +738,13 @@ class NodeService:
         # retried as soon as the block at that height lands.
         self._pending_justs: dict[int, Justification] = {}
         self.sync = None  # node/sync.py SyncManager, via attach_sync()
+        # Durable local state (node/store.py BlockStore, via
+        # attach_store / BlockStore.recover): when attached, every
+        # committed block is journaled + fsync'd before the announce,
+        # and the store checkpoints on its cadence.  None = the
+        # in-memory-only node every test that doesn't pass --data-dir
+        # still gets.
+        self.store = None
 
         # Offences bookkeeping (node side): sessions this node already
         # heartbeat for, offence report keys already submitted/gossiped
@@ -1097,6 +1104,21 @@ class NodeService:
         if len(sink) > EVENT_SINK_MAX:
             del sink[: len(sink) - EVENT_SINK_MAX // 2]
         self.blocks.append(record)
+        # Durability BEFORE acknowledgment: the journal append (fsync
+        # included) runs here, under the lock, ahead of the gossip
+        # announce and _post_block hooks — a block a peer heard about is
+        # a block this node can replay after kill -9.  The store owns
+        # its OSError handling (degraded mode), so a full disk never
+        # kills the authoring/import path.
+        if self.store is not None:
+            self.store.journal_block(
+                block,
+                checkpoint.events_digest(events)
+                if events is not None else "",
+                self.justifications.get(block.number),
+            )
+            self.store.maybe_checkpoint(
+                block, blob, self.justifications.get(block.number))
         self.m_pool.set(len(self.pool))
         self.m_finality_lag.set(block.number - self.finalized_number)
 
@@ -1212,6 +1234,12 @@ class NodeService:
 
     def attach_sync(self, sync) -> None:
         self.sync = sync
+
+    def attach_store(self, store) -> None:
+        """Wire the durable store (node/store.py): called by
+        BlockStore.recover() after the recovery ladder ran, so replayed
+        blocks were imported store-less and are not re-journaled."""
+        self.store = store
 
     def _parent_slot(self, parent: str) -> int:
         blk = self.block_store.get(parent)
@@ -1916,6 +1944,10 @@ class NodeService:
                 n: j for n, j in self._pending_justs.items()
                 if n > just.number
             }
+            # durable finality: replaying the journal after a crash
+            # recovers the finalized head, not just the chain tip
+            if self.store is not None:
+                self.store.journal_justification(just)
         return True
 
     # ------------------------------------------------------ offences
@@ -2277,6 +2309,62 @@ class NodeService:
             self.finalized_hash = bh
             self.justifications[head.number] = justification
             self.m_finalized.set(head.number)
+            if self.store is not None:
+                # the local journal's history no longer chains to the
+                # warped anchor: persist the restored state (re-encoded
+                # at the CURRENT format — the peer blob may be older)
+                # and restart the journal from it
+                self.store.on_warp(
+                    checkpoint.snapshot(self.rt), head, justification)
+        return True
+
+    def restore_local_checkpoint(
+        self, blob: bytes, head: Block,
+        justification: Justification | None = None,
+    ) -> bool:
+        """Disk-recovery restore (node/store.py ladder rung 1): like
+        restore_checkpoint, but for a blob from OUR OWN data dir, so
+        the 2/3-justification requirement is dropped — the trust
+        anchors that remain are exactly the ones a tampered disk cannot
+        forge: the head block must carry a validator's signature over
+        its state_hash, and the restored state must hash to it.  A
+        justification stored next to the checkpoint still verifies in
+        full before it advances the finalized head (an invalid one is
+        ignored, not fatal — finality gossip re-delivers)."""
+        if head is None or not head.signature:
+            return False
+        try:
+            self._check_author_signature(head)
+        except BlockImportError:
+            return False
+        bh = head.hash(self.genesis)
+        with self._lock:
+            if head.number <= self.rt.state.block_number:
+                return False
+            undo = checkpoint.snapshot(self.rt)
+            try:
+                checkpoint.restore(self.rt, blob)
+                ok = (self.rt.state.block_number == head.number
+                      and checkpoint.state_hash(self.rt)
+                      == head.state_hash)
+            except Exception:
+                ok = False
+            if not ok:
+                checkpoint.restore(self.rt, undo)
+                return False
+            self._reset_chain_index(bh, head)
+            if (
+                justification is not None
+                and justification.number == head.number
+                and justification.block_hash == bh
+                and verify_justification(
+                    justification, self.genesis, self.spec.validators,
+                    self.keys)
+            ):
+                self.finalized_number = head.number
+                self.finalized_hash = bh
+                self.justifications[head.number] = justification
+                self.m_finalized.set(head.number)
         return True
 
     def state_hash(self) -> str:

@@ -18,7 +18,10 @@ wire (node/rpc.py):
    peers; small gaps replay the missing block range (`sync_block`),
    large gaps bootstrap from a versioned checkpoint blob
    (`sync_checkpoint`, chain/checkpoint.py format) and replay from
-   there — the warp-sync role (service.rs:259-263).
+   there — the warp-sync role (service.rs:259-263).  Warp is also the
+   *last rung* of the on-disk recovery ladder (node/store.py): a node
+   whose local checkpoint/journal is missing or corrupted degrades to
+   peer catch-up here instead of refusing to start.
 
  * **Finality** (`Vote` / `Justification`) is a GRANDPA stand-in:
    every `finality_period` blocks validators sign the canonical block

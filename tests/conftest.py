@@ -84,6 +84,15 @@ def pytest_configure(config):
         "compile-once counter across a multi-tile stream) — CI runs "
         "these as their own fast gate",
     )
+    config.addinivalue_line(
+        "markers",
+        "persistence: crash-safe store suite (tests/"
+        "test_persistence.py — journal record torture over every byte "
+        "boundary, recovery-ladder prefix property, degraded-mode "
+        "fault discipline, storage fault-plane determinism; tests/"
+        "test_zz_persistence_testnet.py — the kill -9 restart-from-"
+        "disk soak) — CI runs these as their own fast gate",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -108,6 +108,63 @@ class TestVersionedFormat:
         checkpoint.restore(fresh, v1_blob)
         assert checkpoint.state_hash(fresh) == checkpoint.state_hash(rt)
 
+    def test_v1_historical_blob_composes_full_ladder(self):
+        """A TRUE v1-era blob — headerless AND missing every field the
+        later formats introduced (no vrf accumulator, no session /
+        offences / fees pallets, legacy event sink still inside the
+        state payload) — migrates v1→v6 in one restore() call with
+        every MIGRATIONS rung composed, and yields a usable runtime
+        with no untouched pallet clobbered."""
+        rt = small_runtime()
+        data = checkpoint._extract(rt)
+        # regress the payload to the v1 shape
+        for pallet in ("session", "offences", "fees"):
+            data.pop(pallet)
+        for field in ("vrf_accumulator", "vrf_fold_count"):
+            data["rrsc"].pop(field)
+        data["staking"].pop("chilled_until")
+        data["state"]["events"] = [
+            {"pallet": "legacy", "name": "OldSinkEntry"}]
+        out: list[bytes] = []
+        checkpoint._canon(data, out)
+        v1_blob = b"".join(out)
+
+        version, raw = checkpoint.decode_blob(v1_blob)
+        assert version == 1
+        assert "fees" not in raw and "session" not in raw
+
+        fresh = Runtime(copy.copy(rt.config))
+        checkpoint.restore(fresh, v1_blob)  # five rungs, one call
+        # v2→v3: VRF accumulator seeded empty
+        assert fresh.rrsc.vrf_accumulator == bytes(32)
+        assert fresh.rrsc.vrf_fold_count == 0
+        # v3→v4: session + offences explicitly empty, no chills
+        assert fresh.session.session_index == 0
+        assert fresh.offences.reports == {}
+        assert fresh.staking.chilled_until == {}
+        # v4→v5: the legacy in-state event sink is dropped, not
+        # resurrected onto the restored state (what remains is the
+        # fresh construction's own genesis deposits, all Event-typed)
+        assert not any(
+            isinstance(e, dict) for e in fresh.state.events)
+        # v5→v6: fees pallet seeded zeroed
+        assert fresh.fees.block_fees == 0
+        assert fresh.fees.total_fees == 0
+        # untouched pallets survive the ladder byte-identical
+        for pallet in ("state", "sminer", "storage_handler", "oss",
+                       "cacher", "scheduler_credit", "tee_worker",
+                       "file_bank", "audit", "evm"):
+            assert checkpoint._object_state(
+                getattr(fresh, pallet), pallet
+            ) == checkpoint._object_state(
+                getattr(rt, pallet), pallet
+            ), f"pallet {pallet} clobbered by migration ladder"
+        # and the restored runtime is actually usable
+        before = fresh.state.block_number
+        fresh.run_blocks(2)
+        assert fresh.state.block_number == before + 2
+        assert checkpoint.state_hash(fresh)
+
     def test_future_version_rejected(self):
         rt = small_runtime()
         payload = checkpoint.state_encode(rt)

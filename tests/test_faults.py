@@ -119,7 +119,7 @@ class TestSemantics:
 
     def test_profiles_registry(self):
         assert set(PROFILES) == {"off", "light", "mild", "hostile",
-                                 "flood"}
+                                 "flood", "baddisk"}
         assert PROFILES["hostile"].drop > PROFILES["mild"].drop
         # "light" is the sustained-soak profile: lossy link only, no
         # partitions (those are asserted above in this file instead)
@@ -131,3 +131,14 @@ class TestSemantics:
         assert PROFILES["flood"].partition == 0.0
         for name in ("off", "light", "mild", "hostile"):
             assert PROFILES[name].flood_accounts == 0
+        # "baddisk" is the storage-fault profile: it aims ONLY at the
+        # --data-dir store — a healthy network over a lying disk
+        bad = PROFILES["baddisk"]
+        assert bad.disk_enospc > 0 and bad.disk_torn > 0
+        assert bad.disk_flip > 0 and bad.disk_short_read > 0
+        assert bad.drop == 0.0 and bad.partition == 0.0
+        assert bad.flood_accounts == 0
+        # the network profiles leave the disk alone
+        for name in ("off", "light", "mild", "hostile", "flood"):
+            assert PROFILES[name].disk_enospc == 0.0
+            assert PROFILES[name].disk_torn == 0.0

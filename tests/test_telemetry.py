@@ -410,6 +410,50 @@ class TestFleetReporter:
         md = to_markdown(report)
         assert "blocks/s" in md and "import stage" in md
 
+    def test_report_survives_dead_node(self):
+        """One node of the fleet dies mid-window: the report must
+        still build, mark that node unreachable, and keep totals over
+        the survivors (regression: a dead node used to raise out of
+        report() and abort the whole artifact)."""
+        import os
+        import socket
+        import sys
+
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from tools.telemetry_report import FleetCollector, to_markdown
+
+        # reserve a port that is guaranteed closed during the test
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        dead_port = probe.getsockname()[1]
+        probe.close()
+
+        spec, a, _b = make_pair()
+        sa = RpcServer(a, port=0)
+        sa.start()
+        try:
+            collector = FleetCollector(
+                [("127.0.0.1", sa.port), ("127.0.0.1", dead_port)],
+                timeout=1.0)
+            collector.sample()
+            author_block_with_extrinsic(spec, a)
+            collector.sample()
+            report = collector.report(elapsed_s=5.0)
+        finally:
+            sa.stop()
+        assert report["unreachable_nodes"] == 1
+        live = report["per_node"][f"127.0.0.1:{sa.port}"]
+        dead = report["per_node"][f"127.0.0.1:{dead_port}"]
+        assert not live["unreachable"]
+        assert dead["unreachable"]
+        assert dead["samples"] == 0
+        # survivor totals still computed
+        assert live["blocksProduced"] >= 1
+        assert report["fleet"]["blocks_per_s"] >= 0
+        md = to_markdown(report)
+        assert "UNREACHABLE" in md and "survivors" in md
+
 
 class TestProofStageMetrics:
     def test_always_on_stage_histograms(self):

@@ -15,14 +15,21 @@ sys.path.insert(0, ".")
 
 
 def collect_registries():
+    import tempfile
+
     from cess_tpu.node.chain_spec import local_spec
     from cess_tpu.node.service import NodeService
+    from cess_tpu.node.store import BlockStore
     from cess_tpu.node.sync import SyncManager
     from cess_tpu.ops.rs import rs_stage_registry
     from cess_tpu.proof.xla_backend import proof_stage_registry
 
     service = NodeService(local_spec(), authority="alice")
     SyncManager(service, peers=[("127.0.0.1", 1)])
+    # the store registers its cess_store_* families into the service
+    # registry exactly as `--data-dir` wiring does (node/cli.py)
+    with tempfile.TemporaryDirectory() as d:
+        BlockStore(d, registry=service.registry).close()
     return {
         "service": service.registry,
         "proof": proof_stage_registry(),

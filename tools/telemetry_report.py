@@ -141,7 +141,18 @@ class FleetCollector:
             except (OSError, RpcError, ValueError):
                 out[key] = None
         if out.get("metrics"):
-            out["families"] = m.parse_exposition(out["metrics"])
+            # a node killed mid-response hands back truncated
+            # exposition text; a parse blow-up here must cost this
+            # node its scrape, never the whole fleet report
+            try:
+                out["families"] = m.parse_exposition(out["metrics"])
+            except Exception:
+                out["metrics"] = None
+        # node down at report time (crashed mid-window and not yet —
+        # or never — restarted): flag it so report() can mark the
+        # entry instead of silently rendering zeros
+        out["unreachable"] = (out.get("metrics") is None
+                              and out.get("traces") is None)
         return out
 
     def report(self, extra_registries: tuple = (),
@@ -173,6 +184,7 @@ class FleetCollector:
             scrape = scrapes[label]
             fams = scrape.get("families") or {}
             entry: dict = {
+                "unreachable": bool(scrape.get("unreachable")),
                 "samples": len(series),
                 "bestBlock": bests[-1] if bests else None,
                 "finalityLag": {
@@ -240,12 +252,20 @@ class FleetCollector:
             if first_best and last_best else 0.0
         )
 
-        # stitched traces: block traces whose spans live on >1 node
+        # stitched traces: block traces whose spans live on >1 node.
+        # Defensive .get()s: a trace summary from a node that died
+        # mid-serialisation may be missing keys — drop the record,
+        # keep the report.
         trace_nodes: dict[str, set] = {}
         for label, scrape in scrapes.items():
             summary = scrape.get("traces") or {}
-            for t in summary.get("traces", []):
-                if t["root"] in ("block.author", "block.import"):
+            traces = summary.get("traces", []) if isinstance(
+                summary, dict) else []
+            for t in traces:
+                if not isinstance(t, dict):
+                    continue
+                if t.get("root") in ("block.author", "block.import") \
+                        and t.get("traceId"):
                     trace_nodes.setdefault(t["traceId"], set()).add(label)
         stitched = sum(1 for nodes in trace_nodes.values()
                        if len(nodes) > 1)
@@ -318,6 +338,8 @@ class FleetCollector:
                 "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
             "window_s": round(elapsed, 2),
             "nodes": len(self.nodes),
+            "unreachable_nodes": sum(
+                1 for e in per_node.values() if e.get("unreachable")),
             "fleet": {
                 "blocks_per_s": round(blocks_delta / elapsed, 4),
                 "extrinsics_per_s": round(ext_rate_total / elapsed, 4),
@@ -349,7 +371,11 @@ def to_markdown(report: dict) -> str:
         "# Fleet telemetry report",
         "",
         f"Generated {report['generated_at']} over a "
-        f"{report['window_s']} s window across {report['nodes']} nodes.",
+        f"{report['window_s']} s window across {report['nodes']} nodes"
+        + (f" ({report['unreachable_nodes']} unreachable at scrape "
+           "time; fleet totals cover survivors only)"
+           if report.get("unreachable_nodes") else "")
+        + ".",
         "",
         "## Throughput",
         "",
@@ -376,7 +402,8 @@ def to_markdown(report: dict) -> str:
     ]
     for label, entry in report["per_node"].items():
         lines += [
-            f"### {label}",
+            f"### {label}"
+            + (" — UNREACHABLE" if entry.get("unreachable") else ""),
             "",
             f"- best block {entry.get('bestBlock')}, finality lag "
             f"p50/p95 {entry['finalityLag']['p50']}/"
