@@ -253,6 +253,9 @@ class _Reader:
             return int.from_bytes(self.take(n), "big", signed=True)
         if tag == b"F":
             n = int.from_bytes(self.take(2), "big")
+            # cesslint: allow[det-float] decoder for the F tag: the
+            # encoder wrote repr(x), and float(repr(x)) round-trips
+            # bit-exactly on every IEEE-754 platform
             return float(self.take(n).decode())
         if tag == b"S":
             n = int.from_bytes(self.take(4), "big")

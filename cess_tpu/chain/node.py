@@ -63,6 +63,8 @@ from functools import lru_cache
 def _sim_authority():
     """Deterministic fixture root, generated once per process (the RSA
     prime search is ~0.1 s and the output is seed-fixed)."""
+    # cesslint: allow[det-random] fixed-seed fixture RNG — every replica
+    # derives the identical IAS root from b"sim-ias-root"
     return ias.fixture_authority(random.Random(b"sim-ias-root"), bits=1024)
 
 
@@ -79,6 +81,8 @@ def _sim_report(podr2_pbk: bytes):
     return ias.fixture_report(
         root_priv,
         report_json,
+        # cesslint: allow[det-random] fixed-seed fixture RNG keyed on the
+        # worker pubkey — deterministic across replicas by construction
         random.Random(b"sim-tee-report" + podr2_pbk),
         bits=1024,
     )

@@ -401,14 +401,20 @@ class RpcApi:
             caller = _h160(tx.get("from", "0x" + "00" * 20))
             data = bytes.fromhex(tx.get("data", "0x")[2:])
             gas = int(tx.get("gas", "0x989680"), 16)
-            snap = s.rt.evm._snapshot()
-            try:
-                res = s.rt.evm.call(
-                    caller, _h160(tx["to"]), data=data,
-                    value=int(tx.get("value", "0x0"), 16), gas=gas,
-                )
-            finally:
-                s.rt.evm._restore(snap)
+            # snapshot/execute/restore mutate live EVM state: without
+            # the service lock a concurrent block execution on the
+            # authoring/import thread interleaves with the scratch run
+            # and the restore clobbers committed writes (cesslint
+            # lock-rpc-private)
+            with s._lock:
+                snap = s.rt.evm._snapshot()
+                try:
+                    res = s.rt.evm.call(
+                        caller, _h160(tx["to"]), data=data,
+                        value=int(tx.get("value", "0x0"), 16), gas=gas,
+                    )
+                finally:
+                    s.rt.evm._restore(snap)
             if not res.success:
                 raise RpcError(-32015, f"execution reverted: {res.error}")
             return "0x" + res.return_data.hex()
@@ -419,21 +425,24 @@ class RpcApi:
 
             caller = _h160(tx.get("from", "0x" + "00" * 20))
             data = bytes.fromhex(tx.get("data", "0x")[2:])
-            snap = s.rt.evm._snapshot()
-            try:
-                if tx.get("to"):
-                    res = s.rt.evm.call(
-                        caller, _h160(tx["to"]), data=data,
-                        value=int(tx.get("value", "0x0"), 16),
-                        gas=30_000_000,
-                    )
-                else:
-                    res = s.rt.evm.create(
-                        caller, data, value=int(tx.get("value", "0x0"), 16),
-                        gas=30_000_000,
-                    )
-            finally:
-                s.rt.evm._restore(snap)
+            # same scratch-run discipline as eth_call above
+            with s._lock:
+                snap = s.rt.evm._snapshot()
+                try:
+                    if tx.get("to"):
+                        res = s.rt.evm.call(
+                            caller, _h160(tx["to"]), data=data,
+                            value=int(tx.get("value", "0x0"), 16),
+                            gas=30_000_000,
+                        )
+                    else:
+                        res = s.rt.evm.create(
+                            caller, data,
+                            value=int(tx.get("value", "0x0"), 16),
+                            gas=30_000_000,
+                        )
+                finally:
+                    s.rt.evm._restore(snap)
             if not res.success:
                 raise RpcError(-32015, f"execution reverted: {res.error}")
             return hex(res.gas_used + G_TX)

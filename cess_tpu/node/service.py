@@ -332,23 +332,23 @@ class TxPool:
         self.max_bytes = max_bytes
         self.per_account = per_account
         self.future_band = future_band
-        self._by_account: dict[str, dict[int, PoolEntry]] = {}
-        self._hashes: set[str] = set()
-        self._bytes = 0
-        self._count = 0
-        self._seq = 0
-        self.evictions = 0  # lifetime, mirrored into cess_pool_evictions
+        self._by_account: dict[str, dict[int, PoolEntry]] = {}  # guarded-by: _lock
+        self._hashes: set[str] = set()  # guarded-by: _lock
+        self._bytes = 0  # guarded-by: _lock
+        self._count = 0  # guarded-by: _lock
+        self._seq = 0  # guarded-by: _lock
+        self.evictions = 0  # lifetime (cess_pool_evictions)  # guarded-by: _lock
 
     # -------------------------------------------------------- internals
 
-    def _insert(self, entry: PoolEntry) -> None:
+    def _insert(self, entry: PoolEntry) -> None:  # holds-lock: _lock
         self._by_account.setdefault(
             entry.ext.signer, {})[entry.ext.nonce] = entry
         self._hashes.add(entry.hash)
         self._bytes += entry.size
         self._count += 1
 
-    def _drop(self, entry: PoolEntry) -> None:
+    def _drop(self, entry: PoolEntry) -> None:  # holds-lock: _lock
         acct = self._by_account.get(entry.ext.signer)
         if acct is None or acct.get(entry.ext.nonce) is not entry:
             return
@@ -677,10 +677,10 @@ class NodeService:
         )
         # hash → rejection reason for PERMANENTLY invalid extrinsics
         # (see REJECT_CACHE_MAX) — checked before the signature pairing
-        self._ext_rejected: OrderedDict[str, str] = OrderedDict()
-        self.nonces: dict[str, int] = {}
-        self.blocks: list[BlockRecord] = []
-        self.slot = 0
+        self._ext_rejected: OrderedDict[str, str] = OrderedDict()  # guarded-by: _lock
+        self.nonces: dict[str, int] = {}  # guarded-by: _lock
+        self.blocks: list[BlockRecord] = []  # guarded-by: _lock
+        self.slot = 0  # guarded-by: _lock
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -699,10 +699,10 @@ class NodeService:
         # Block store + head anchor (the chain-DB role): parent of block
         # #1 is the genesis spec hash; recent post-state blobs allow
         # head-reorg rollback and failed-import recovery.
-        self.head_hash = self.genesis
-        self.block_store: dict[str, Block] = {}
-        self.block_by_number: dict[int, Block] = {}
-        self._state_blobs: OrderedDict[str, bytes] = OrderedDict()
+        self.head_hash = self.genesis  # guarded-by: _lock
+        self.block_store: dict[str, Block] = {}  # guarded-by: _lock
+        self.block_by_number: dict[int, Block] = {}  # guarded-by: _lock
+        self._state_blobs: OrderedDict[str, bytes] = OrderedDict()  # guarded-by: _lock
         self._state_blobs[self.genesis] = checkpoint.snapshot(self.rt)
 
         # Observability (node/tracing.py + the per-block event ring):
@@ -714,29 +714,29 @@ class NodeService:
         # deterministic and bit-identical across replicas but OUTSIDE
         # the consensus state hash).
         self.tracer = tracing.Tracer(node=authority or "dev")
-        self.block_traces: OrderedDict[str, str] = OrderedDict()
+        self.block_traces: OrderedDict[str, str] = OrderedDict()  # guarded-by: _lock
         self.events_by_block: OrderedDict[str, tuple[int, list]] = (
-            OrderedDict())
+            OrderedDict())  # guarded-by: _lock
 
         # Finality (node/sync.py GRANDPA stand-in): collected votes per
         # (number, hash), targets this node already voted, and accepted
         # justifications by number.
-        self.finalized_number = 0
-        self.finalized_hash = self.genesis
-        self._votes: dict[tuple[int, str], dict[str, str]] = {}
-        self._voted: set[int] = set()
+        self.finalized_number = 0  # guarded-by: _lock
+        self.finalized_hash = self.genesis  # guarded-by: _lock
+        self._votes: dict[tuple[int, str], dict[str, str]] = {}  # guarded-by: _lock
+        self._voted: set[int] = set()  # guarded-by: _lock
         # Equivocation bookkeeping: which hash each voter signed per
         # height, and voters proven to have signed two hashes at one
         # height (their weight counts for NEITHER fork — one Byzantine
         # validator must not be able to complete conflicting 2/3
         # quorums on different replicas).
-        self._vote_hash: dict[int, dict[str, str]] = {}
-        self._equivocators: dict[int, set[str]] = {}
-        self.justifications: dict[int, Justification] = {}
+        self._vote_hash: dict[int, dict[str, str]] = {}  # guarded-by: _lock
+        self._equivocators: dict[int, set[str]] = {}  # guarded-by: _lock
+        self.justifications: dict[int, Justification] = {}  # guarded-by: _lock
         # Verified justifications whose target block we have not
         # imported yet (gossip often outruns the ~0.4s import path);
         # retried as soon as the block at that height lands.
-        self._pending_justs: dict[int, Justification] = {}
+        self._pending_justs: dict[int, Justification] = {}  # guarded-by: _lock
         self.sync = None  # node/sync.py SyncManager, via attach_sync()
         # Durable local state (node/store.py BlockStore, via
         # attach_store / BlockStore.recover): when attached, every
@@ -751,8 +751,8 @@ class NodeService:
         # (gossip floods re-deliver each report N-1 times), and the
         # chaos knob that mutes the heartbeat OCW (--chaos-mute — a
         # deliberately lazy validator for liveness drills).
-        self._hb_sent: set[int] = set()
-        self._offences_seen: set[tuple] = set()
+        self._hb_sent: set[int] = set()  # guarded-by: _lock
+        self._offences_seen: set[tuple] = set()  # guarded-by: _lock
         self.chaos_mute = False
         # Self-healing candidacy: True once this node has observed its
         # own authority in staking.candidates — only then will the OCW
@@ -840,7 +840,7 @@ class NodeService:
 
     # ------------------------------------------------------ submission
 
-    def _cache_rejection(self, h: str, reason: str) -> None:
+    def _cache_rejection(self, h: str, reason: str) -> None:  # holds-lock: _lock
         """Remember a PERMANENTLY invalid extrinsic hash (caller holds
         the lock): redelivery re-raises from here before any pairing."""
         self._ext_rejected[h] = reason
@@ -1071,7 +1071,7 @@ class NodeService:
             return dev_sk(author, self.spec.chain_id)
         return None
 
-    def _commit_block(
+    def _commit_block(  # holds-lock: _lock
         self, block: Block, record: BlockRecord, blob: bytes,
         events: list | None = None, trace: str | None = None,
     ) -> None:
@@ -1245,7 +1245,7 @@ class NodeService:
         blk = self.block_store.get(parent)
         return blk.slot if blk is not None else 0
 
-    def _requeue_retracted(self, blocks: list[Block]) -> None:
+    def _requeue_retracted(self, blocks: list[Block]) -> None:  # holds-lock: _lock
         """Reorg aftercare: a retracted block's extrinsics go back into
         the pool so they land on the winning chain in a later block
         (the reference pool's retraction behavior) instead of vanishing."""
@@ -1269,7 +1269,7 @@ class NodeService:
                     self.nonces[ev.ext.signer] = ev.ext.nonce
             self._update_pool_metrics()
 
-    def _rollback_head(
+    def _rollback_head(  # holds-lock: _lock
         self,
     ) -> tuple[Block, str, bytes, BlockRecord | None, list | None]:
         """Drop the current head (same-height fork choice lost): restore
@@ -1308,7 +1308,7 @@ class NodeService:
         self.m_reorgs.inc()
         return head, head_hash, head_blob, record, head_events
 
-    def _retract_events(self, block_hash: str) -> list | None:
+    def _retract_events(self, block_hash: str) -> list | None:  # holds-lock: _lock
         """Drop a retracted block's ring entry and rewind the runtime
         sink if its tail is still exactly that block's events (the
         sink is append-only; checkpoint blobs no longer carry it)."""
@@ -1322,7 +1322,7 @@ class NodeService:
             del sink[-n:]
         return events
 
-    def _reinstate_head(
+    def _reinstate_head(  # holds-lock: _lock
         self, head: Block, head_hash: str, head_blob: bytes,
         record: BlockRecord | None, head_events: list | None,
     ) -> None:
@@ -1544,7 +1544,7 @@ class NodeService:
         if not bls.verify(pk, block.signing_payload(self.genesis), sig):
             raise BlockImportError("bad author signature")
 
-    def _verify_and_apply(
+    def _verify_and_apply(  # holds-lock: _lock
         self, block: Block, author_verified: bool = False,
         sigs_verified: bool = False,
     ) -> tuple[BlockRecord, bytes, list]:
@@ -1999,9 +1999,15 @@ class NodeService:
         paths dedup on the report key — gossip floods re-deliver every
         report N-1 times."""
         key = report.key()
-        if key in self._offences_seen:
-            return
-        self._offences_seen.add(key)
+        # check-then-act under the lock: this runs on the RPC/gossip
+        # thread (sync_offence → handle_offence_report) concurrently
+        # with the import path's _offences_seen reads — an unlocked
+        # add() here raced a duplicate report into two submissions
+        # (cesslint lock-guarded-write)
+        with self._lock:
+            if key in self._offences_seen:
+                return
+            self._offences_seen.add(key)
         self.m_offences.inc()
         ident = self._ocw_identity
         can_sign = (
@@ -2209,7 +2215,7 @@ class NodeService:
         with self._lock:
             return checkpoint.snapshot(self.rt)
 
-    def _reset_chain_index(self, anchor_hash: str, head: Block | None) -> None:
+    def _reset_chain_index(self, anchor_hash: str, head: Block | None) -> None:  # holds-lock: _lock
         """Re-anchor block bookkeeping after a state restore: history
         before the restored state is not held, so the anchor (a synthetic
         hash, or the peer-supplied head block) becomes the parent of the

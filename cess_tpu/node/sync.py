@@ -78,6 +78,8 @@ SYNC_PROTO_VERSION = 5
 
 # Peer-gossip socket timeout: announcements are fire-and-forget, a dead
 # peer must not stall the authoring loop.
+# cesslint: allow[det-float] socket timeout — network plumbing, never
+# consensus state
 GOSSIP_TIMEOUT_S = 3.0
 
 # Max gossip messages queued per peer.  A hung peer drains at ~1 message
@@ -93,6 +95,8 @@ GOSSIP_QUEUE_MAX = 64
 # casts keep their one-timeout guarantee — only the catch-up pull path
 # retries, where one dropped packet otherwise costs a whole lap.
 CATCHUP_RPC_ATTEMPTS = 3
+# cesslint: allow[det-float] retry backoff base — network plumbing, never
+# consensus state
 CATCHUP_BACKOFF_BASE_S = 0.05
 
 # Header-range batch verification during catch-up: above this gap the
@@ -387,6 +391,8 @@ class SyncManager:
 
     def _mark_peer_seen(self, peer) -> None:
         with self._queue_lock:
+            # cesslint: allow[det-wallclock] peer-freshness telemetry for
+            # system_health only — never hashed or signed
             self._peer_seen[self._peer_label(peer)] = time.time()
 
     def peers_seen(self) -> dict[str, float]:
@@ -409,6 +415,8 @@ class SyncManager:
 
             try:
                 if delay:
+                    # cesslint: allow[det-wallclock] chaos-injected link
+                    # latency on this peer's own gossip worker
                     # injected link latency: sleeping in the peer's own
                     # single worker backs up only that peer's queue,
                     # exactly like a slow real link
@@ -427,6 +435,8 @@ class SyncManager:
                     self._queued[peer] -= 1
 
         for peer in self.peers:
+            # cesslint: allow[det-float] gossip-delay seconds — wire
+            # scheduling, never consensus state
             sends = [(0.0, (method, params))]
             if self.faults is not None:
                 shape = self.faults.shape_gossip(peer, (method, params))
@@ -493,12 +503,17 @@ class SyncManager:
         last: OSError | None = None
         for attempt in range(max(1, attempts)):
             if attempt:
+                # cesslint: allow[det-float] backoff jitter fraction —
+                # deterministic (blake2b-seeded) and never consensus state
                 frac = int.from_bytes(hashlib.blake2b(
                     f"{host}:{port}/{method}/{attempt}".encode(),
                     digest_size=2,
                 ).digest(), "big") / 0xFFFF
+                # cesslint: allow[det-wallclock] bounded retry backoff on
+                # the catch-up pull path — wire scheduling only
                 time.sleep(
                     CATCHUP_BACKOFF_BASE_S * (2 ** (attempt - 1))
+                    # cesslint: allow[det-float] jitter factor, see above
                     * (1.0 + frac)
                 )
             try:
@@ -824,6 +839,8 @@ class SyncManager:
         """Warp-sync: restore the peer's versioned state blob and anchor
         the head so subsequent imports chain onto it."""
         try:
+            # cesslint: allow[det-float] RPC timeout seconds — network
+            # plumbing, never consensus state
             d = self._peer_call(host, port, "sync_checkpoint", [], 30.0)
         except _rpc_errors():
             return False

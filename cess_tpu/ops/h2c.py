@@ -674,8 +674,14 @@ def _map_pairs_kernel(u, sgn, exc):
     iso(m1), matching the host's per-point route).  Fully fused Pallas
     kernel on TPU; per-op XLA elsewhere — bit-identical either way."""
     if jax.default_backend() == "tpu" and u.shape[2] % _MAP_TILE == 0:
-        return jax.jit(_map_pairs_pallas)(u, sgn, exc)
+        return _map_pairs_pallas_jit(u, sgn, exc)
     return _map_pairs_xla(u, sgn, exc)
+
+
+# Module-level jit (the glv.py _glv_fold_pallas_jit idiom): building
+# the wrapper inside _map_pairs_kernel re-traced the Pallas kernel on
+# every eager call (hash_pairs_device) — caught by cesslint jit-in-body.
+_map_pairs_pallas_jit = jax.jit(_map_pairs_pallas)
 
 
 # ------------------------------------------------------------- host API

@@ -768,6 +768,8 @@ class RSStream:
             groups.setdefault(p, []).append(i)
         for mask, idx in groups.items():
             inv = _inv_cached(code.k, code.m, mask)
+            # cesslint: allow[host-sync] np.asarray on a host-side
+            # python index list (group gather rows), not a device value
             self._stream_slabs(inv, batch, out, np.asarray(idx))
         self._account(batch.nbytes, t_start)
         return out

@@ -19,6 +19,8 @@ bit-identical to the single-device kernel — asserted in tests on a virtual
 
 from __future__ import annotations
 
+from functools import lru_cache
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -63,6 +65,21 @@ def _combine_local(w: jnp.ndarray, mu: jnp.ndarray) -> jnp.ndarray:
     return fr._fold_to_canonical(total)
 
 
+@lru_cache(maxsize=8)
+def _combine_fn(mesh: Mesh):
+    """Jitted sharded combine, cached per mesh — building the jit per
+    call re-traced the shard_map on every audit round (the glv bug
+    class; caught by cesslint jit-in-body)."""
+    fn = shard_map(
+        _combine_local,
+        mesh=mesh,
+        in_specs=(P(BATCH_AXIS, None), P(BATCH_AXIS, None, None)),
+        out_specs=P(None, None),
+        check_rep=False,
+    )
+    return jax.jit(fn)
+
+
 def combine_mu_sharded(
     mesh: Mesh, rho_limbs: np.ndarray, mu_limbs: np.ndarray
 ) -> np.ndarray:
@@ -72,14 +89,9 @@ def combine_mu_sharded(
     B must divide by mesh size (pad with ρ=0 rows host-side).
     Returns (S, NLIMBS) canonical int32 limbs, identical on every device.
     """
-    fn = shard_map(
-        _combine_local,
-        mesh=mesh,
-        in_specs=(P(BATCH_AXIS, None), P(BATCH_AXIS, None, None)),
-        out_specs=P(None, None),
-        check_rep=False,
+    return np.asarray(
+        _combine_fn(mesh)(jnp.asarray(rho_limbs), jnp.asarray(mu_limbs))
     )
-    return np.asarray(jax.jit(fn)(jnp.asarray(rho_limbs), jnp.asarray(mu_limbs)))
 
 
 def _audit_step_local(

@@ -93,6 +93,13 @@ def pytest_configure(config):
         "test_zz_persistence_testnet.py — the kill -9 restart-from-"
         "disk soak) — CI runs these as their own fast gate",
     )
+    config.addinivalue_line(
+        "markers",
+        "cesslint: static-analysis suite (tests/test_cesslint.py — "
+        "per-rule fixtures, pragma/baseline mechanics, the self-run "
+        "over the real tree) — CI runs these as their own fast gate, "
+        "excluded from the main test run",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,299 @@
+"""cesslint suite: per-rule fixtures, pragma/baseline mechanics, and the
+self-run over the real tree.
+
+Runs as its own CI gate (`pytest -m cesslint`) next to the raw
+`python -m tools.cesslint` invocation; the fixtures under
+tools/cesslint/fixtures/ are the executable rule spec — every rule has
+a firing example and a clean counterpart using the sanctioned idiom.
+"""
+
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from tools.cesslint import core
+from tools.cesslint.core import Finding, SourceFile
+
+pytestmark = pytest.mark.cesslint
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = REPO / "tools" / "cesslint" / "fixtures"
+
+CHAIN_PATH = "cess_tpu/chain/fixture.py"  # determinism-scoped
+PALLET_PATH = "cess_tpu/pallets/fixture.py"  # out of determinism scope
+HOT_PATH = "cess_tpu/ops/rs.py"  # host-sync hot file
+RPC_PATH = "cess_tpu/node/rpc.py"
+CKPT_PATH = "cess_tpu/chain/checkpoint.py"
+
+
+def lint(path, text, passes, docs=None, baseline=None):
+    sf = SourceFile.from_text(path, text)
+    kept, suppressed = core.run_tree(
+        [sf], docs or {}, passes=passes, baseline=baseline
+    )
+    return kept, suppressed
+
+
+def rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+def fixture(name):
+    return (FIXTURES / name).read_text()
+
+
+# -------------------------------------------------------- determinism
+
+
+class TestDeterminism:
+    def test_every_det_rule_fires_on_bad_fixture(self):
+        kept, _ = lint(CHAIN_PATH, fixture("det_bad.py"), ("determinism",))
+        assert rules(kept) == [
+            "det-env", "det-float", "det-random", "det-unsorted-iter",
+            "det-wallclock",
+        ]
+        # three distinct float hazards: literal, float(), true division
+        assert len([f for f in kept if f.rule == "det-float"]) == 3
+
+    def test_clean_fixture_is_clean(self):
+        kept, _ = lint(CHAIN_PATH, fixture("det_ok.py"), ("determinism",))
+        assert kept == []
+
+    def test_scoped_rules_silent_outside_consensus_paths(self):
+        kept, _ = lint(PALLET_PATH, fixture("det_bad.py"), ("determinism",))
+        # det-unsorted-iter is tree-wide; the scoped rules must not fire
+        assert rules(kept) == ["det-unsorted-iter"]
+
+    def test_unsorted_iter_catches_items_and_set(self):
+        src = (
+            "def enc(d, canonical_json):\n"
+            "    a = canonical_json([v for _, v in d.items()])\n"
+            "    b = canonical_json(list(set(d)))\n"
+            "    return a + b\n"
+        )
+        kept, _ = lint(PALLET_PATH, src, ("determinism",))
+        assert len(kept) == 2
+        assert rules(kept) == ["det-unsorted-iter"]
+
+    def test_state_encode_is_a_sink_too(self):
+        src = "def enc(d, state_encode):\n    return state_encode(d.values())\n"
+        kept, _ = lint(PALLET_PATH, src, ("determinism",))
+        assert rules(kept) == ["det-unsorted-iter"]
+
+
+# ---------------------------------------------------------- recompile
+
+
+class TestRecompile:
+    def test_both_jit_in_body_shapes_fire(self):
+        kept, _ = lint(HOT_PATH, fixture("recompile_bad.py"), ("recompile",))
+        jit = [f for f in kept if f.rule == "jit-in-body"]
+        assert len(jit) == 2  # direct invocation + via-local
+
+    def test_host_sync_fires_in_hot_file_loops(self):
+        kept, _ = lint(HOT_PATH, fixture("recompile_bad.py"), ("recompile",))
+        sync = [f for f in kept if f.rule == "host-sync"]
+        assert len(sync) == 3  # .item(), np.asarray, jax.device_get
+
+    def test_host_sync_silent_outside_hot_files(self):
+        kept, _ = lint(
+            PALLET_PATH, fixture("recompile_bad.py"), ("recompile",)
+        )
+        assert rules(kept) == ["jit-in-body"]
+
+    def test_accepted_caching_patterns_are_clean(self):
+        kept, _ = lint(HOT_PATH, fixture("recompile_ok.py"), ("recompile",))
+        assert kept == []
+
+
+# -------------------------------------------------------------- locks
+
+
+class TestLocks:
+    def test_off_lock_writes_and_mutators_fire(self):
+        kept, _ = lint(RPC_PATH, fixture("locks_bad.py"), ("locks",))
+        guarded = [f for f in kept if f.rule == "lock-guarded-write"]
+        rpc = [f for f in kept if f.rule == "lock-rpc-private"]
+        assert len(guarded) == 3  # subscript store, augassign, .pop()
+        assert len(rpc) == 2  # private call + write through `s`
+
+    def test_with_lock_and_holds_lock_are_clean(self):
+        kept, _ = lint(RPC_PATH, fixture("locks_ok.py"), ("locks",))
+        assert kept == []
+
+    def test_init_is_exempt(self):
+        src = (
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self.x = {}  # guarded-by: _lock\n"
+            "        self.x['seed'] = 1\n"
+        )
+        kept, _ = lint(PALLET_PATH, src, ("locks",))
+        assert kept == []
+
+    def test_rpc_rule_only_applies_to_rpc_module(self):
+        kept, _ = lint(PALLET_PATH, fixture("locks_bad.py"), ("locks",))
+        assert rules(kept) == ["lock-guarded-write"]
+
+
+# ------------------------------------------------------------ surface
+
+
+class TestSurface:
+    def test_migrations_contiguity(self):
+        kept, _ = lint(CKPT_PATH, fixture("surface_bad.py"), ("surface",))
+        mig = [f for f in kept if f.rule == "surface-migrations"]
+        msgs = "\n".join(f.message for f in mig)
+        assert len(mig) == 2
+        assert "no v2→v3 step" in msgs  # missing rung
+        assert "key 7 outside" in msgs  # dead/future rung
+
+    def test_rpc_docs_coverage(self):
+        text = fixture("surface_bad.py")
+        kept, _ = lint(RPC_PATH, text, ("surface",))
+        assert "surface-rpc-docs" in rules(kept)
+        kept, _ = lint(
+            RPC_PATH, text, ("surface",),
+            docs={"docs/rpc.md": "| `ghost_undocumented` | spooky |"},
+        )
+        assert "surface-rpc-docs" not in rules(kept)
+
+    def test_metrics_help(self):
+        kept, _ = lint(PALLET_PATH, fixture("surface_bad.py"), ("surface",))
+        help_ = [f for f in kept if f.rule == "surface-metrics-help"]
+        assert len(help_) == 1  # fixture_dropped only; fixture_named ok
+
+    def test_collections_counter_not_confused(self):
+        src = (
+            "from collections import Counter\n"
+            "c = Counter('abracadabra')\n"
+        )
+        kept, _ = lint(PALLET_PATH, src, ("surface",))
+        assert kept == []
+
+
+# ------------------------------------------------------------- pragmas
+
+
+class TestPragmas:
+    SRC = "import time\n\n\ndef f():\n    return time.time(){pragma}\n"
+
+    def test_same_line_pragma_suppresses(self):
+        src = self.SRC.format(
+            pragma="  # cesslint: allow[det-wallclock] sim-only timer"
+        )
+        kept, suppressed = lint(CHAIN_PATH, src, ("determinism",))
+        assert kept == []
+        assert len(suppressed) == 1
+
+    def test_line_above_and_block_pragmas_suppress(self):
+        src = (
+            "import time\n\n\ndef f():\n"
+            "    # cesslint: allow[det-wallclock] sim-only timer whose\n"
+            "    # justification spans a comment block\n"
+            "    return time.time()\n"
+        )
+        kept, suppressed = lint(CHAIN_PATH, src, ("determinism",))
+        assert kept == []
+        assert len(suppressed) == 1
+
+    def test_pragma_without_reason_is_a_finding(self):
+        src = self.SRC.format(pragma="  # cesslint: allow[det-wallclock]")
+        kept, _ = lint(CHAIN_PATH, src, ("determinism",))
+        assert rules(kept) == ["pragma"]
+        assert "without a reason" in kept[0].message
+
+    def test_unknown_rule_is_a_finding(self):
+        src = "X = 1  # cesslint: allow[no-such-rule] because\n"
+        kept, _ = lint(CHAIN_PATH, src, ("determinism",))
+        assert rules(kept) == ["pragma"]
+        assert "unknown rule" in kept[0].message
+
+    def test_unused_pragma_is_a_finding(self):
+        src = "X = 1  # cesslint: allow[det-wallclock] nothing here\n"
+        kept, _ = lint(CHAIN_PATH, src, ("determinism",))
+        assert rules(kept) == ["pragma"]
+        assert "unused" in kept[0].message
+
+    def test_unused_check_scoped_to_active_passes(self):
+        # a host-sync pragma is not "unused" during a locks-only run
+        src = "X = 1  # cesslint: allow[host-sync] streamed index list\n"
+        kept, _ = lint(CHAIN_PATH, src, ("locks",))
+        assert kept == []
+
+
+# ------------------------------------------------------------ baseline
+
+
+class TestBaseline:
+    def test_round_trip(self, tmp_path):
+        f = Finding("surface-rpc-docs", "cess_tpu/node/rpc.py", 7, "msg")
+        p = tmp_path / "baseline.txt"
+        p.write_text(core.render_baseline([f]))
+        assert core.load_baseline(p) == {f.baseline_key()}
+
+    def test_det_entries_refused(self, tmp_path):
+        p = tmp_path / "baseline.txt"
+        p.write_text("det-wallclock\tcess_tpu/chain/x.py\tmsg\n")
+        with pytest.raises(ValueError, match="may not be baselined"):
+            core.load_baseline(p)
+
+    def test_baseline_suppresses_by_key_not_line(self):
+        src = "def f(s, d, canonical_json):\n    return canonical_json(d.values())\n"
+        kept, _ = lint(PALLET_PATH, src, ("determinism",))
+        key = kept[0].baseline_key()
+        kept2, suppressed = lint(
+            PALLET_PATH, "\n\n" + src, ("determinism",), baseline={key}
+        )
+        assert kept2 == []
+        assert len(suppressed) == 1
+
+    def test_committed_baseline_is_empty(self):
+        keys = core.load_baseline(REPO / "tools/cesslint/baseline.txt")
+        assert keys == set()
+
+
+# ------------------------------------------------------------ self-run
+
+
+class TestSelfRun:
+    def test_tree_is_clean_and_analyzer_imports_no_jax(self):
+        proc = subprocess.run(
+            [
+                sys.executable, "-c",
+                "import sys\n"
+                "from tools.cesslint import load_tree, run_tree\n"
+                "files, docs = load_tree()\n"
+                "kept, _ = run_tree(files, docs)\n"
+                "assert 'jax' not in sys.modules, 'analyzer imported jax'\n"
+                "assert 'cess_tpu' not in sys.modules\n"
+                "sys.exit(1 if kept else 0)\n",
+            ],
+            cwd=REPO, capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_cli_exits_zero(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.cesslint"],
+            cwd=REPO, capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "cesslint: ok" in proc.stdout
+
+    def test_cli_fails_on_unknown_pass(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.cesslint", "--passes", "nope"],
+            cwd=REPO, capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 2
+
+    def test_metrics_shim_delegates(self):
+        proc = subprocess.run(
+            [sys.executable, "tools/lint_metrics.py"],
+            cwd=REPO, capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "surface-metrics-help" in proc.stdout

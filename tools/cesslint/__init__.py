@@ -1,0 +1,12 @@
+"""cesslint: consensus-determinism, JAX-recompile, lock-discipline and
+surface-consistency static analysis for the cess-tpu tree.
+
+Pure-AST analyzer — importing this package must never import jax or
+cess_tpu (the CI lint job runs it in seconds on a bare checkout; a
+fixture test asserts `jax` stays out of sys.modules).  See
+docs/static-analysis.md for the rule catalog and pragma syntax.
+"""
+
+from .core import Finding, SourceFile, load_tree, run_tree  # noqa: F401
+
+PASSES = ("determinism", "recompile", "locks", "surface")

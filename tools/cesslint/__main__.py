@@ -1,0 +1,112 @@
+"""CLI: `python -m tools.cesslint` — the CI lint gate.
+
+Exit 0 when every finding is pragma'd or baselined, 1 otherwise.
+Never imports jax or cess_tpu: the gate runs on a bare checkout in
+seconds, before any test job spends minutes compiling kernels.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+from . import PASSES
+from .core import (
+    REPO_ROOT,
+    load_baseline,
+    load_tree,
+    render_baseline,
+    run_tree,
+)
+
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.txt"
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="cesslint",
+        description="consensus-determinism / recompile / lock-discipline"
+        " / surface static analysis (docs/static-analysis.md)",
+    )
+    ap.add_argument(
+        "--root", default=str(REPO_ROOT),
+        help="repo root to analyze (default: this checkout)",
+    )
+    ap.add_argument(
+        "--passes", default=",".join(PASSES),
+        help=f"comma-separated subset of {','.join(PASSES)}",
+    )
+    ap.add_argument(
+        "--baseline", default=str(DEFAULT_BASELINE),
+        help="baseline file of grandfathered findings",
+    )
+    ap.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the baseline (report everything unsuppressed)",
+    )
+    ap.add_argument(
+        "--write-baseline", action="store_true",
+        help="rewrite the baseline from the current findings and exit 0",
+    )
+    args = ap.parse_args(argv)
+
+    t0 = time.perf_counter()
+    passes = tuple(p.strip() for p in args.passes.split(",") if p.strip())
+    unknown = [p for p in passes if p not in PASSES]
+    if unknown:
+        print(f"cesslint: unknown pass(es): {', '.join(unknown)}",
+              file=sys.stderr)
+        return 2
+
+    files, docs = load_tree(args.root)
+    baseline: set[str] | None = None
+    if not args.no_baseline and not args.write_baseline:
+        path = Path(args.baseline)
+        if path.exists():
+            try:
+                baseline = load_baseline(path)
+            except ValueError as exc:
+                print(f"cesslint: {exc}", file=sys.stderr)
+                return 2
+
+    kept, suppressed = run_tree(
+        files, docs, passes=passes, baseline=baseline
+    )
+
+    if args.write_baseline:
+        baselineable = [
+            f for f in kept if not f.rule.startswith("det-")
+            and f.rule != "pragma"
+        ]
+        Path(args.baseline).write_text(render_baseline(baselineable))
+        refused = len(kept) - len(baselineable)
+        print(
+            f"cesslint: wrote {len(baselineable)} finding(s) to "
+            f"{args.baseline}"
+            + (f" ({refused} det-*/pragma finding(s) refused — fix or "
+               f"pragma those)" if refused else "")
+        )
+        return 0
+
+    for f in kept:
+        print(f.render())
+    dt = time.perf_counter() - t0
+    status = "FAIL" if kept else "ok"
+    print(
+        f"cesslint: {status} — {len(files)} files, "
+        f"{'/'.join(passes)}: {len(kept)} finding(s), "
+        f"{len(suppressed)} suppressed (pragma/baseline), {dt:.2f}s"
+    )
+    if kept:
+        print(
+            "fix the code, add `# cesslint: allow[rule] reason`, or "
+            "(non-determinism rules only) baseline with "
+            "--write-baseline; see docs/static-analysis.md",
+        )
+    return 1 if kept else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,267 @@
+"""cesslint core: source loading, pragmas, annotations, baseline, runner.
+
+The unit of analysis is a SourceFile — path, text, parsed AST, and the
+comment side-channel (pragmas plus the lock-discipline annotation
+vocabulary), extracted once with tokenize so every pass shares it.
+
+Suppression model, in order of application:
+
+  1. `# cesslint: allow[rule] reason` on the finding's line (or the
+     line directly above) suppresses that rule there.  The reason is
+     mandatory — a bare pragma is itself a finding, and so is a pragma
+     that suppresses nothing (rule id `pragma`).
+  2. The committed baseline (tools/cesslint/baseline.txt) grandfathers
+     findings by (rule, path, message) — no line numbers, so unrelated
+     edits don't churn it.  Determinism findings may NOT be baselined:
+     replicas either agree bit-for-bit or fork, so `det-*` entries are
+     rejected at load time (fix the code or justify with a pragma).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+
+PRAGMA_RE = re.compile(
+    r"#\s*cesslint:\s*allow\[([A-Za-z0-9_\-, ]+)\]\s*(.*)"
+)
+GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_]\w*)")
+HOLDS_RE = re.compile(r"#\s*holds-lock:\s*([A-Za-z_]\w*)")
+
+# Only determinism rules are barred from the baseline; every other
+# pass may carry grandfathered findings while they're burned down.
+UNBASELINEABLE_PREFIX = "det-"
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def baseline_key(self) -> str:
+        return f"{self.rule}\t{self.path}\t{self.message}"
+
+
+@dataclass
+class Pragma:
+    line: int
+    rules: tuple[str, ...]
+    reason: str
+    used: bool = False
+
+
+@dataclass
+class SourceFile:
+    path: str  # repo-relative, forward slashes
+    text: str
+    tree: ast.AST
+    pragmas: dict[int, Pragma] = field(default_factory=dict)
+    guarded: dict[int, str] = field(default_factory=dict)  # line -> lock
+    holds: dict[int, str] = field(default_factory=dict)  # line -> lock
+
+    @classmethod
+    def from_text(cls, path: str, text: str) -> "SourceFile":
+        tree = ast.parse(text, filename=path)
+        sf = cls(path=path, text=text, tree=tree)
+        raw_lines = text.splitlines()
+        for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+            if tok.type != tokenize.COMMENT:
+                continue
+            line = tok.start[0]
+            m = PRAGMA_RE.search(tok.string)
+            if m:
+                rules = tuple(
+                    r.strip() for r in m.group(1).split(",") if r.strip()
+                )
+                pragma = Pragma(line, rules, m.group(2).strip())
+                sf.pragmas[line] = pragma
+                # a pragma opening a full-line comment block (possibly
+                # a multi-line justification) covers the first code
+                # line below the block
+                target = line
+                while target <= len(raw_lines) and raw_lines[
+                    target - 1
+                ].lstrip().startswith("#"):
+                    target += 1
+                sf.pragmas.setdefault(target, pragma)
+            m = GUARDED_RE.search(tok.string)
+            if m:
+                sf.guarded[line] = m.group(1)
+            m = HOLDS_RE.search(tok.string)
+            if m:
+                sf.holds[line] = m.group(1)
+        return sf
+
+    def pragma_for(self, line: int) -> Pragma | None:
+        """Pragma on the line itself, on the line directly above, or
+        opening the comment block directly above."""
+        return self.pragmas.get(line) or self.pragmas.get(line - 1)
+
+
+# ------------------------------------------------------------ tree load
+
+
+def _iter_py(root: Path):
+    for sub in ("cess_tpu", "tools"):
+        base = root / sub
+        if base.is_dir():
+            yield from sorted(base.rglob("*.py"))
+    yield from sorted(root.glob("*.py"))
+
+
+def load_tree(root: Path | str = REPO_ROOT):
+    """(files, docs): every repo .py outside tests/ parsed, plus the
+    docs/*.md corpus the surface pass greps for RPC coverage."""
+    root = Path(root)
+    files: list[SourceFile] = []
+    for p in _iter_py(root):
+        rel = p.relative_to(root).as_posix()
+        if rel.startswith("tools/cesslint/fixtures/"):
+            continue
+        try:
+            files.append(SourceFile.from_text(rel, p.read_text()))
+        except SyntaxError as exc:
+            raise RuntimeError(f"cesslint: cannot parse {rel}: {exc}")
+    docs = {
+        p.relative_to(root).as_posix(): p.read_text()
+        for p in sorted((root / "docs").glob("*.md"))
+    }
+    return files, docs
+
+
+# ------------------------------------------------------------- baseline
+
+
+def load_baseline(path: Path | str) -> set[str]:
+    keys: set[str] = set()
+    text = Path(path).read_text()
+    for ln, raw in enumerate(text.splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        rule = line.split("\t", 1)[0]
+        if rule.startswith(UNBASELINEABLE_PREFIX):
+            raise ValueError(
+                f"{path}:{ln}: determinism findings may not be "
+                f"baselined (rule {rule}) — fix the code or add a "
+                f"justified pragma"
+            )
+        keys.add(line)
+    return keys
+
+
+def render_baseline(findings: list[Finding]) -> str:
+    lines = [
+        "# cesslint baseline — grandfathered findings, one per line as",
+        "# rule<TAB>path<TAB>message.  det-* rules are refused at load",
+        "# time: determinism findings must be fixed or pragma'd, never",
+        "# baselined.  Burn this file down, don't grow it.",
+    ]
+    for f in sorted(set(findings), key=lambda f: (f.path, f.rule, f.message)):
+        lines.append(f.baseline_key())
+    return "\n".join(lines) + "\n"
+
+
+# -------------------------------------------------------------- runner
+
+
+def run_tree(
+    files: list[SourceFile],
+    docs: dict[str, str] | None = None,
+    passes: tuple[str, ...] = ("determinism", "recompile", "locks", "surface"),
+    baseline: set[str] | None = None,
+) -> tuple[list[Finding], list[Finding]]:
+    """Run the selected passes.  Returns (kept, suppressed): kept are
+    the findings that should fail the build (pragma/`pragma`-rule
+    findings included), suppressed are those silenced by a pragma or
+    the baseline."""
+    from . import determinism, locks, recompile, surface
+
+    raw: list[Finding] = []
+    if "determinism" in passes:
+        raw += determinism.run(files)
+    if "recompile" in passes:
+        raw += recompile.run(files)
+    if "locks" in passes:
+        raw += locks.run(files)
+    if "surface" in passes:
+        raw += surface.run(files, docs or {})
+
+    by_path = {f.path: f for f in files}
+    kept: list[Finding] = []
+    suppressed: list[Finding] = []
+    for f in raw:
+        sf = by_path.get(f.path)
+        pragma = sf.pragma_for(f.line) if sf else None
+        if pragma and f.rule in pragma.rules:
+            pragma.used = True
+            suppressed.append(f)
+        elif baseline and f.baseline_key() in baseline:
+            suppressed.append(f)
+        else:
+            kept.append(f)
+
+    # pragma hygiene: every suppression carries a reason, every pragma
+    # suppresses something, every rule name is real.  Unused-pragma
+    # checks only consider rules whose pass ran — a det-* pragma is
+    # not "unused" during a locks-only invocation.
+    known = set(ALL_RULES)
+    active = {
+        r for p in passes for r in RULES_OF_PASS.get(p, ())
+    }
+    seen_pragmas: set[int] = set()
+    for sf in files:
+        for pragma in sf.pragmas.values():
+            if id(pragma) in seen_pragmas:
+                continue  # block-propagated alias of the same pragma
+            seen_pragmas.add(id(pragma))
+            for rule in pragma.rules:
+                if rule not in known:
+                    kept.append(Finding(
+                        "pragma", sf.path, pragma.line,
+                        f"unknown rule {rule!r} in allow[...] pragma",
+                    ))
+            if not pragma.reason:
+                kept.append(Finding(
+                    "pragma", sf.path, pragma.line,
+                    "allow[...] pragma without a reason — justify the "
+                    "suppression",
+                ))
+            if not pragma.used and pragma.rules and set(
+                pragma.rules
+            ) <= known and set(pragma.rules) & active:
+                kept.append(Finding(
+                    "pragma", sf.path, pragma.line,
+                    f"unused allow[{','.join(pragma.rules)}] pragma — "
+                    "suppresses nothing on this line",
+                ))
+    kept.sort(key=lambda f: (f.path, f.line, f.rule))
+    return kept, suppressed
+
+
+RULES_OF_PASS = {
+    "determinism": (
+        "det-wallclock", "det-random", "det-env", "det-float",
+        "det-unsorted-iter",
+    ),
+    "recompile": ("jit-in-body", "host-sync"),
+    "locks": ("lock-guarded-write", "lock-rpc-private"),
+    "surface": (
+        "surface-migrations", "surface-rpc-docs", "surface-metrics-help",
+    ),
+}
+
+ALL_RULES = tuple(
+    r for rules in RULES_OF_PASS.values() for r in rules
+) + ("pragma",)

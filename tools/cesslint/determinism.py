@@ -1,0 +1,190 @@
+"""Determinism pass: consensus-critical code must be a pure function of
+chain state.
+
+GRANDPA-style accountable safety only holds if every replica's state
+transition is bit-deterministic — a replica that reads the clock, an
+env var, its RNG, or float rounding into the state hash forks the
+network silently.  Scope: `cess_tpu/chain/*`, `cess_tpu/consensus/*`,
+and `cess_tpu/node/sync.py` (the import path that owns
+`canonical_json`, THE consensus byte encoding).
+
+Rules:
+  det-wallclock     time.* / datetime.now-family calls
+  det-random        any use of the `random` module (seeded fixture use
+                    is justified with a pragma, e.g. chain/node.py)
+  det-env           os.environ / os.getenv reads
+  det-float         float literals in expressions, float() calls, and
+                    `/` true division (use integer math: //, Perbill)
+  det-unsorted-iter (tree-wide) .values()/.keys()/.items()/set() feeding
+                    canonical_json or state_encode without sorted()
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, SourceFile
+
+SCOPED_PREFIXES = ("cess_tpu/chain/", "cess_tpu/consensus/")
+SCOPED_FILES = ("cess_tpu/node/sync.py",)
+
+WALLCLOCK_TIME = {
+    "time", "time_ns", "monotonic", "monotonic_ns", "perf_counter",
+    "perf_counter_ns", "sleep", "localtime", "gmtime", "ctime",
+}
+WALLCLOCK_DATETIME = {"now", "utcnow", "today"}
+
+# the sinks every consensus payload flows through: block/extrinsic/vote
+# signing bytes (node/sync.py canonical_json) and the checkpoint state
+# hash (chain/checkpoint.py state_encode)
+CANONICAL_SINKS = {"canonical_json", "state_encode"}
+UNSORTED_ITERS = {"values", "keys", "items"}
+
+
+def _in_scope(path: str) -> bool:
+    return path.startswith(SCOPED_PREFIXES) or path in SCOPED_FILES
+
+
+def _call_name(node: ast.Call) -> str | None:
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    return None
+
+
+def run(files: list[SourceFile]) -> list[Finding]:
+    out: list[Finding] = []
+    for sf in files:
+        out += _unsorted_iter(sf)
+        if _in_scope(sf.path):
+            out += _scoped_rules(sf)
+    return out
+
+
+def _scoped_rules(sf: SourceFile) -> list[Finding]:
+    out: list[Finding] = []
+
+    def flag(rule: str, node: ast.AST, msg: str) -> None:
+        out.append(Finding(rule, sf.path, node.lineno, msg))
+
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and isinstance(
+                f.value, ast.Name
+            ):
+                base, attr = f.value.id, f.attr
+                if base == "time" and attr in WALLCLOCK_TIME:
+                    flag(
+                        "det-wallclock", node,
+                        f"wall-clock call time.{attr}() in "
+                        "consensus-critical code",
+                    )
+                elif base == "random":
+                    flag(
+                        "det-random", node,
+                        f"random.{attr}() in consensus-critical code — "
+                        "replicas each draw their own",
+                    )
+                elif base == "datetime" and attr in WALLCLOCK_DATETIME:
+                    flag(
+                        "det-wallclock", node,
+                        f"wall-clock call datetime.{attr}() in "
+                        "consensus-critical code",
+                    )
+                elif base == "os" and attr == "getenv":
+                    flag(
+                        "det-env", node,
+                        "os.getenv() in consensus-critical code — env "
+                        "vars differ per replica",
+                    )
+            if isinstance(f, ast.Name) and f.id == "float":
+                flag(
+                    "det-float", node,
+                    "float() in consensus-critical code — float "
+                    "rounding is not portable across replicas",
+                )
+        elif isinstance(node, ast.Attribute):
+            if (
+                isinstance(node.value, ast.Name)
+                and node.value.id == "os"
+                and node.attr == "environ"
+            ):
+                flag(
+                    "det-env", node,
+                    "os.environ read in consensus-critical code — env "
+                    "vars differ per replica",
+                )
+        elif isinstance(node, ast.Constant):
+            if type(node.value) is float:
+                flag(
+                    "det-float", node,
+                    f"float literal {node.value!r} in consensus-critical "
+                    "code — use integer math",
+                )
+        elif isinstance(node, ast.BinOp) and isinstance(node.op, ast.Div):
+            flag(
+                "det-float", node,
+                "true division `/` yields a float in consensus-critical "
+                "code — use `//`",
+            )
+    return out
+
+
+def _unsorted_iter(sf: SourceFile) -> list[Finding]:
+    """Unordered-iteration results feeding a canonical sink.  dict keys
+    are safe through canonical_json (sort_keys) — the hazard is VALUE
+    ordering: lists built off .values()/.items()/set iteration hash in
+    whatever order the container yields unless sorted() pins it."""
+    out: list[Finding] = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _call_name(node) not in CANONICAL_SINKS:
+            continue
+        for arg in node.args:
+            for bad, label in _unordered_nodes(arg):
+                out.append(Finding(
+                    "det-unsorted-iter", sf.path, bad.lineno,
+                    f"{label} feeds {_call_name(node)}() without "
+                    "sorted() — iteration order leaks into consensus "
+                    "bytes",
+                ))
+    return out
+
+
+def _unordered_nodes(arg: ast.AST):
+    """(node, label) pairs for unordered iterations under `arg` that are
+    not wrapped in a sorted() call on the way up to the sink arg."""
+    parents: dict[ast.AST, ast.AST] = {}
+    for parent in ast.walk(arg):
+        for child in ast.iter_child_nodes(parent):
+            parents[child] = parent
+
+    def sorted_above(node: ast.AST) -> bool:
+        cur = parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.Call) and isinstance(
+                cur.func, ast.Name
+            ) and cur.func.id == "sorted":
+                return True
+            cur = parents.get(cur)
+        return False
+
+    for node in ast.walk(arg):
+        if not isinstance(node, ast.Call):
+            continue
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in UNSORTED_ITERS
+            and not node.args
+        ):
+            if not sorted_above(node):
+                yield node, f".{node.func.attr}() iteration"
+        elif (
+            isinstance(node.func, ast.Name)
+            and node.func.id == "set"
+        ):
+            if not sorted_above(node):
+                yield node, "set() construction"

@@ -1,0 +1,34 @@
+# cesslint fixture — every determinism rule fires here.  Loaded by
+# tests/test_cesslint.py under a consensus-scoped path; excluded from
+# load_tree so the self-run stays clean.
+import os
+import random
+import time
+
+
+def slot_now():
+    return time.time()  # det-wallclock
+
+
+def jitter():
+    return random.random()  # det-random
+
+
+def node_id():
+    return os.environ["NODE_ID"]  # det-env
+
+
+def reward_share(total, n):
+    return total / n  # det-float (true division)
+
+
+SCALE = 1.5  # det-float (literal)
+
+
+def as_score(x):
+    return float(x)  # det-float (call)
+
+
+def vote_bytes(votes, canonical_json):
+    # det-unsorted-iter: value order leaks into consensus bytes
+    return canonical_json(list(votes.values()))

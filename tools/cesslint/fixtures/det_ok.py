@@ -1,0 +1,14 @@
+# cesslint fixture — determinism-clean counterparts of det_bad.py.
+
+
+def reward_share(total, n):
+    return total // n
+
+
+def vote_bytes(votes, canonical_json):
+    return canonical_json(sorted(votes.values()))
+
+
+def key_bytes(votes, canonical_json):
+    # dict KEYS are safe: canonical_json sorts keys itself
+    return canonical_json(votes)

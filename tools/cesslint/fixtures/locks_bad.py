@@ -1,0 +1,23 @@
+# cesslint fixture — guarded-field writes off-lock, and an RPC handler
+# reaching a private through the service object.  Loaded by tests under
+# cess_tpu/node/rpc.py-style paths as needed.
+import threading
+
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.entries = {}  # guarded-by: _lock
+        self.count = 0  # guarded-by: _lock
+
+    def submit(self, k, v):
+        self.entries[k] = v  # lock-guarded-write (subscript store)
+        self.count += 1  # lock-guarded-write (augassign)
+
+    def drop(self, k):
+        self.entries.pop(k, None)  # lock-guarded-write (mutator)
+
+
+def handler(s, args):
+    s._restore(args)  # lock-rpc-private (call)
+    s.rt.evm._scratch = args  # lock-rpc-private (write)

@@ -1,0 +1,24 @@
+# cesslint fixture — the three sanctioned ways to touch guarded state.
+import threading
+
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.entries = {}  # guarded-by: _lock
+        self.count = 0  # guarded-by: _lock
+
+    def submit(self, k, v):
+        with self._lock:
+            self.entries[k] = v
+            self.count += 1
+
+    def _insert(self, k, v):  # holds-lock: _lock
+        self.entries[k] = v
+        self.count += 1
+
+
+def handler(s, args):
+    with s._lock:
+        s._restore(args)
+        s.rt.evm._scratch = args

@@ -1,0 +1,22 @@
+# cesslint fixture — the glv bug class: jit built and invoked per call,
+# and host syncs inside a hot-section loop.
+import jax
+import numpy as np
+
+
+def fold_per_call(f, x):
+    return jax.jit(f)(x)  # jit-in-body (direct invocation)
+
+
+def fold_via_local(f, x):
+    g = jax.jit(f)
+    return g(x)  # jit-in-body (local later called)
+
+
+def stream(chunks):
+    total = 0
+    for c in chunks:
+        total += c.sum().item()  # host-sync
+        _ = np.asarray(c)  # host-sync
+        _ = jax.device_get(c)  # host-sync
+    return total

@@ -1,0 +1,26 @@
+# cesslint fixture — every accepted jit caching pattern.
+from functools import lru_cache
+
+import jax
+
+
+def _kernel(x):
+    return x + 1
+
+
+_kernel_jit = jax.jit(_kernel)  # module-level: compiled once
+
+
+@lru_cache(maxsize=8)
+def cached_factory(shape):
+    return jax.jit(_kernel)  # lru_cache owns the lifetime
+
+
+def plain_factory():
+    # returns WITHOUT calling — the caller owns the caching
+    # (parallel/msm.py module-dict idiom)
+    return jax.jit(_kernel)
+
+
+def hot_entry(x):
+    return _kernel_jit(x)

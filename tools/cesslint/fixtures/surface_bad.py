@@ -1,0 +1,33 @@
+# cesslint fixture — surface-pass violations.  Tests load this text
+# under the checkpoint-module and rpc-module paths; each sub-rule only
+# looks at its own constructs.
+from cess_tpu.node.metrics import Counter
+
+
+def _noop(state):
+    return state
+
+
+FORMAT_VERSION = 4
+MIGRATIONS = {
+    1: _noop,
+    # v2→v3 rung missing: surface-migrations
+    3: _noop,
+    7: _noop,  # outside 1..3: surface-migrations (dead/future rung)
+}
+
+
+def method(name):
+    def deco(fn):
+        return fn
+
+    return deco
+
+
+@method("ghost_undocumented")  # surface-rpc-docs unless docs mention it
+def ghost(s, args):
+    return None
+
+
+dropped = Counter("fixture_dropped")  # surface-metrics-help
+named = Counter("fixture_named", "has help text")

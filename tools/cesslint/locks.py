@@ -1,0 +1,231 @@
+"""Lock-discipline pass: annotation-driven guarded-field checking.
+
+The bls12_381 coeff-cache KeyError was a check-then-act race on a field
+shared between the authoring loop and RPC/gossip threads.  This pass
+makes the locking contract machine-checked via two comment annotations:
+
+  self.blocks = {}          # guarded-by: _lock
+      declares the field is only touched under `with self.<lock>`;
+  def _commit_block(...):   # holds-lock: _lock
+      declares the method is only ever entered with the lock already
+      held (an internal helper below a locked public entry point), so
+      its writes need no lexical `with`.
+
+Rules:
+  lock-guarded-write  a write (assign / augassign / del / subscript
+                      store) or mutator call (.add/.append/.pop/...)
+                      on a guarded `self.<field>` outside `with
+                      self.<lock>`, in any method of the annotated
+                      class other than __init__ / holds-lock methods.
+  lock-rpc-private    node/rpc.py handlers run on server threads; a
+                      call to an underscore-private attribute reachable
+                      through the closed-over service object (`s.rt.evm.
+                      _restore(...)`) bypasses the locked public API —
+                      require `with s._lock` or go through a public
+                      method.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, SourceFile
+
+MUTATORS = {
+    "add", "append", "appendleft", "clear", "discard", "extend",
+    "insert", "pop", "popitem", "popleft", "push", "remove",
+    "setdefault", "update",
+}
+
+RPC_FILE = "cess_tpu/node/rpc.py"
+
+
+def run(files: list[SourceFile]) -> list[Finding]:
+    out: list[Finding] = []
+    for sf in files:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef):
+                out += _check_class(sf, node)
+        if sf.path == RPC_FILE:
+            out += _check_rpc(sf)
+    return out
+
+
+# --------------------------------------------------- guarded-field core
+
+
+def _guarded_fields(sf: SourceFile, cls: ast.ClassDef) -> dict[str, str]:
+    """{field: lock} from `# guarded-by:` comments on self.X = ... lines
+    anywhere in the class body (normally __init__)."""
+    fields: dict[str, str] = {}
+    for node in ast.walk(cls):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        lock = sf.guarded.get(node.lineno) or sf.guarded.get(
+            getattr(node.value, "end_lineno", node.lineno) or node.lineno
+        )
+        if lock is None:
+            continue
+        targets = (
+            node.targets if isinstance(node, ast.Assign) else [node.target]
+        )
+        for tgt in targets:
+            if (
+                isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"
+            ):
+                fields[tgt.attr] = lock
+    return fields
+
+
+def _self_field(node: ast.AST) -> str | None:
+    """The first attribute on a chain rooted at `self`, descending
+    through attributes/subscripts: self.blocks[h].x → 'blocks'."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        base = node.value
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(base, ast.Name)
+            and base.id == "self"
+        ):
+            return node.attr
+        node = base
+    return None
+
+
+def _with_locks(stack: list[ast.AST], root: str = "self") -> set[str]:
+    """Lock attrs held lexically: every `with <root>.<attr>` on the
+    ancestor stack."""
+    held: set[str] = set()
+    for node in stack:
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        for item in node.items:
+            expr = item.context_expr
+            if (
+                isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == root
+            ):
+                held.add(expr.attr)
+    return held
+
+
+def _check_class(sf: SourceFile, cls: ast.ClassDef) -> list[Finding]:
+    fields = _guarded_fields(sf, cls)
+    if not fields:
+        return []
+    out: list[Finding] = []
+    for method in cls.body:
+        if not isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if method.name == "__init__":
+            continue  # construction happens-before publication
+        held_always = sf.holds.get(method.lineno) or sf.holds.get(
+            method.lineno - 1
+        )
+        out += _check_method(sf, cls, method, fields, held_always)
+    return out
+
+
+def _check_method(sf, cls, method, fields, held_always) -> list[Finding]:
+    out: list[Finding] = []
+
+    def flag(node: ast.AST, field: str, what: str) -> None:
+        out.append(Finding(
+            "lock-guarded-write", sf.path, node.lineno,
+            f"{cls.name}.{method.name}: {what} guarded field "
+            f"self.{field} outside `with self.{fields[field]}` "
+            "(annotate the method `# holds-lock:` if callers hold it)",
+        ))
+
+    def visit(node: ast.AST, stack: list[ast.AST]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and (
+            node is not method
+        ):
+            return  # nested defs get their own discipline via callers
+        held = _with_locks(stack)
+
+        def protected(field: str) -> bool:
+            return held_always == fields[field] or fields[field] in held
+
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (
+                node.targets
+                if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for tgt in targets:
+                field = _self_field(tgt)
+                if field in fields and not protected(field):
+                    flag(tgt, field, "write to")
+        elif isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                field = _self_field(tgt)
+                if field in fields and not protected(field):
+                    flag(tgt, field, "del on")
+        elif isinstance(node, ast.Call) and isinstance(
+            node.func, ast.Attribute
+        ):
+            if node.func.attr in MUTATORS:
+                field = _self_field(node.func.value)
+                if field in fields and not protected(field):
+                    flag(node, field, f".{node.func.attr}() on")
+        for child in ast.iter_child_nodes(node):
+            visit(child, stack + [node])
+
+    for stmt in method.body:
+        visit(stmt, [])
+    return out
+
+
+# ------------------------------------------------------ rpc.py handlers
+
+
+def _check_rpc(sf: SourceFile) -> list[Finding]:
+    """RPC handlers close over `s = self.service` and run on server
+    threads.  Private (`_`-prefixed) attribute calls through `s` reach
+    service/runtime internals without the locked public API."""
+    out: list[Finding] = []
+
+    def service_rooted(node: ast.AST) -> bool:
+        while isinstance(node, (ast.Attribute, ast.Subscript)):
+            node = node.value
+        return isinstance(node, ast.Name) and node.id == "s"
+
+    def visit(node: ast.AST, stack: list[ast.AST]) -> None:
+        held = _with_locks(stack, root="s")
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr.startswith("_")
+            and service_rooted(node.func.value)
+            and "_lock" not in held
+        ):
+            out.append(Finding(
+                "lock-rpc-private", sf.path, node.lineno,
+                f"RPC thread calls private {node.func.attr}() through "
+                "the service outside `with s._lock` — use a public "
+                "method or take the lock",
+            ))
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (
+                node.targets
+                if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for tgt in targets:
+                if isinstance(
+                    tgt, (ast.Attribute, ast.Subscript)
+                ) and service_rooted(tgt) and "_lock" not in held:
+                    out.append(Finding(
+                        "lock-rpc-private", sf.path, tgt.lineno,
+                        "RPC thread writes service state outside "
+                        "`with s._lock`",
+                    ))
+        for child in ast.iter_child_nodes(node):
+            visit(child, stack + [node])
+
+    visit(sf.tree, [])
+    return out

@@ -1,0 +1,163 @@
+"""Recompile-hazard pass: the glv bug class, statically.
+
+PR 1 shipped `glv_fold` calling `jax.jit(partial(...))` per invocation
+— every call re-traced and re-compiled the kernel, and the north-star
+bench regressed 10x before anyone noticed.  The accepted patterns are:
+
+  * module-level `@jax.jit` / `X = jax.jit(f)` — compiled once;
+  * an `@lru_cache`d factory returning the jit (ops/rs.py);
+  * a plain factory that RETURNS the jit object without calling it
+    (parallel/verify.py audit_data_plane_step, ops/bigmod.py) — the
+    caller owns the caching (e.g. parallel/msm.py's module-dict);
+
+What gets flagged (`jit-in-body`): a `jax.jit(...)` constructed inside
+an un-cached function body whose result is INVOKED in that same body,
+directly (`jax.jit(f)(x)`) or via a local later called — i.e. a fresh
+trace cache built and thrown away per call.
+
+`host-sync` guards the streamed/fused hot sections (proof/fused.py,
+ops/rs.py, parallel/verify.py): `.item()`, `np.asarray(...)`, or
+`jax.device_get(...)` inside a for/while body stalls the dispatch
+pipeline mid-stream — pull results once, after block_until_ready.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, SourceFile
+
+HOT_FILES = (
+    "cess_tpu/proof/fused.py",
+    "cess_tpu/ops/rs.py",
+    "cess_tpu/parallel/verify.py",
+)
+
+CACHE_DECORATORS = {"lru_cache", "cache"}
+
+
+def run(files: list[SourceFile]) -> list[Finding]:
+    out: list[Finding] = []
+    for sf in files:
+        if not sf.path.startswith("cess_tpu/"):
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out += _check_function(sf, node)
+        if sf.path in HOT_FILES:
+            out += _host_sync(sf)
+    return out
+
+
+def _decorator_name(dec: ast.AST) -> str | None:
+    if isinstance(dec, ast.Call):
+        dec = dec.func
+    if isinstance(dec, ast.Attribute):
+        return dec.attr
+    if isinstance(dec, ast.Name):
+        return dec.id
+    return None
+
+
+def _is_cached(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> bool:
+    return any(
+        _decorator_name(d) in CACHE_DECORATORS for d in fn.decorator_list
+    )
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "jit"
+        and isinstance(node.func.value, ast.Name)
+        and node.func.value.id == "jax"
+    )
+
+
+def _check_function(sf: SourceFile, fn) -> list[Finding]:
+    if _is_cached(fn):
+        return []
+    out: list[Finding] = []
+    parents: dict[ast.AST, ast.AST] = {}
+    # walk this function only, skipping nested defs (checked separately)
+    own_nodes: list[ast.AST] = []
+    stack: list[ast.AST] = list(fn.body)
+    while stack:
+        node = stack.pop()
+        own_nodes.append(node)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+            stack.append(child)
+
+    # names bound to a jit object in this body
+    jit_locals: set[str] = set()
+    for node in own_nodes:
+        if isinstance(node, ast.Assign) and _is_jax_jit(node.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    jit_locals.add(tgt.id)
+
+    for node in own_nodes:
+        if _is_jax_jit(node):
+            parent = parents.get(node)
+            if isinstance(parent, ast.Call) and parent.func is node:
+                out.append(Finding(
+                    "jit-in-body", sf.path, node.lineno,
+                    f"jax.jit(...) constructed and invoked per call in "
+                    f"{fn.name}() — traces/compiles every invocation; "
+                    "cache the jitted fn (lru_cache factory or "
+                    "module level)",
+                ))
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id in jit_locals
+        ):
+            out.append(Finding(
+                "jit-in-body", sf.path, node.lineno,
+                f"locally built jax.jit object {node.func.id!r} invoked "
+                f"inside {fn.name}() — the trace cache dies with the "
+                "call; cache the jitted fn (lru_cache factory or "
+                "module level)",
+            ))
+    return out
+
+
+def _host_sync(sf: SourceFile) -> list[Finding]:
+    out: list[Finding] = []
+    for loop in ast.walk(sf.tree):
+        if not isinstance(loop, (ast.For, ast.While)):
+            continue
+        for node in ast.walk(loop):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr == "item"
+                and not node.args
+            ):
+                out.append(Finding(
+                    "host-sync", sf.path, node.lineno,
+                    ".item() inside a hot-section loop — host sync "
+                    "stalls the dispatch stream; pull once after "
+                    "block_until_ready",
+                ))
+            elif (
+                isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and (
+                    (f.value.id == "np" and f.attr == "asarray")
+                    or (f.value.id == "jax" and f.attr == "device_get")
+                )
+            ):
+                out.append(Finding(
+                    "host-sync", sf.path, node.lineno,
+                    f"{f.value.id}.{f.attr}(...) inside a hot-section "
+                    "loop — device→host pull per iteration kills the "
+                    "transfer/compute overlap",
+                ))
+    return out

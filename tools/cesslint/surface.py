@@ -1,0 +1,157 @@
+"""Surface-consistency pass: the repo's public surfaces stay coherent.
+
+Three sub-rules, all static:
+
+  surface-migrations   chain/checkpoint.py FORMAT_VERSION = N requires
+                       MIGRATIONS to hold exactly the contiguous chain
+                       {1, ..., N-1} — a version bump without its
+                       migration bricks every node restoring an older
+                       checkpoint (the v2..v6 ladder grew one rung per
+                       format bump for exactly this reason).
+  surface-rpc-docs     every `@method("name")` registered in
+                       node/rpc.py must appear in docs/*.md (the
+                       catalog lives in docs/rpc.md) — an undocumented
+                       method is unusable and unreviewable.
+  surface-metrics-help every Counter/Gauge/Histogram/LabeledCounter
+                       construction carries non-empty help text — the
+                       static successor of tools/lint_metrics.py
+                       (`# HELP`-less metrics are dead weight on a
+                       dashboard).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, SourceFile
+
+CHECKPOINT_FILE = "cess_tpu/chain/checkpoint.py"
+RPC_FILE = "cess_tpu/node/rpc.py"
+METRIC_CLASSES = {"Counter", "Gauge", "Histogram", "LabeledCounter"}
+
+
+def run(files: list[SourceFile], docs: dict[str, str]) -> list[Finding]:
+    out: list[Finding] = []
+    for sf in files:
+        if sf.path == CHECKPOINT_FILE:
+            out += _migrations(sf)
+        if sf.path == RPC_FILE:
+            out += _rpc_docs(sf, docs)
+        out += _metrics_help(sf)
+    return out
+
+
+def _migrations(sf: SourceFile) -> list[Finding]:
+    version = None
+    version_line = 1
+    migration_keys: set[int] = set()
+    migrations_line = 1
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        names = [
+            t.id for t in node.targets if isinstance(t, ast.Name)
+        ]
+        if "FORMAT_VERSION" in names and isinstance(
+            node.value, ast.Constant
+        ):
+            version = node.value.value
+            version_line = node.lineno
+        if "MIGRATIONS" in names and isinstance(node.value, ast.Dict):
+            migrations_line = node.lineno
+            for key in node.value.keys:
+                if isinstance(key, ast.Constant) and isinstance(
+                    key.value, int
+                ):
+                    migration_keys.add(key.value)
+    if version is None:
+        return [Finding(
+            "surface-migrations", sf.path, 1,
+            "FORMAT_VERSION literal not found in checkpoint module",
+        )]
+    expected = set(range(1, version))
+    out = []
+    for missing in sorted(expected - migration_keys):
+        out.append(Finding(
+            "surface-migrations", sf.path, migrations_line,
+            f"MIGRATIONS has no v{missing}→v{missing + 1} step — the "
+            f"chain to FORMAT_VERSION={version} must be contiguous",
+        ))
+    for extra in sorted(migration_keys - expected):
+        out.append(Finding(
+            "surface-migrations", sf.path, migrations_line,
+            f"MIGRATIONS key {extra} outside 1..{version - 1} — dead "
+            "or future migration; bump FORMAT_VERSION with the step",
+        ))
+    return out
+
+
+def _rpc_docs(sf: SourceFile, docs: dict[str, str]) -> list[Finding]:
+    corpus = "\n".join(docs.values())
+    out: list[Finding] = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not (
+            isinstance(node.func, ast.Name) and node.func.id == "method"
+        ):
+            continue
+        if not (node.args and isinstance(node.args[0], ast.Constant)):
+            continue
+        name = node.args[0].value
+        if isinstance(name, str) and name not in corpus:
+            out.append(Finding(
+                "surface-rpc-docs", sf.path, node.lineno,
+                f"RPC method {name!r} is registered but appears in no "
+                "docs/*.md — add it to the docs/rpc.md catalog",
+            ))
+    return out
+
+
+def _metrics_help(sf: SourceFile) -> list[Finding]:
+    # skip the defining module (its __init__ signatures default help to
+    # "") and anything outside the package
+    if not sf.path.startswith("cess_tpu/") or sf.path.endswith(
+        "node/metrics.py"
+    ):
+        return []
+    out: list[Finding] = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        cls = None
+        if isinstance(f, ast.Attribute) and f.attr in METRIC_CLASSES:
+            cls = f.attr
+        elif isinstance(f, ast.Name) and f.id in METRIC_CLASSES:
+            # bare names collide with collections.Counter — only treat
+            # as a metric when imported from the metrics module
+            if _imports_from_metrics(sf, f.id):
+                cls = f.id
+        if cls is None:
+            continue
+        help_arg = None
+        if len(node.args) >= 2:
+            help_arg = node.args[1]
+        for kw in node.keywords:
+            if kw.arg == "help_":
+                help_arg = kw.value
+        if help_arg is None or (
+            isinstance(help_arg, ast.Constant) and not help_arg.value
+        ):
+            out.append(Finding(
+                "surface-metrics-help", sf.path, node.lineno,
+                f"{cls}(...) registered without help text — every "
+                "metric must render a # HELP line",
+            ))
+    return out
+
+
+def _imports_from_metrics(sf: SourceFile, name: str) -> bool:
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.ImportFrom) and node.module and (
+            node.module.endswith("metrics")
+        ):
+            if any(a.name == name for a in node.names):
+                return True
+    return False

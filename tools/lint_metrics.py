@@ -1,56 +1,34 @@
-"""Metrics lint: every registered metric must carry non-empty help text.
+"""Metrics lint — thin shim over cesslint's surface pass.
 
-CI gate (build-and-test.yml): constructs the full metric surface — a
-networked NodeService + SyncManager registry and the process-wide
-proof-stage registry — and fails if any metric would render without a
-# HELP line.  A nameless metric is unusable from a dashboard; this
-keeps the exposition self-describing as the surface grows.
+Historically this script imported the node stack, instantiated the full
+metric surface, and checked every registered metric for help text.  That
+check is now the `surface-metrics-help` rule in tools/cesslint (pure
+AST, no cess_tpu import, so it also covers registries the old runtime
+walk couldn't reach without JAX).  This entry point is kept because CI
+and docs/observability.md reference `python tools/lint_metrics.py`; it
+delegates to the surface pass and preserves the exit-code contract.
 """
 
 from __future__ import annotations
 
 import sys
+from pathlib import Path
 
-sys.path.insert(0, ".")
-
-
-def collect_registries():
-    import tempfile
-
-    from cess_tpu.node.chain_spec import local_spec
-    from cess_tpu.node.service import NodeService
-    from cess_tpu.node.store import BlockStore
-    from cess_tpu.node.sync import SyncManager
-    from cess_tpu.ops.rs import rs_stage_registry
-    from cess_tpu.proof.xla_backend import proof_stage_registry
-
-    service = NodeService(local_spec(), authority="alice")
-    SyncManager(service, peers=[("127.0.0.1", 1)])
-    # the store registers its cess_store_* families into the service
-    # registry exactly as `--data-dir` wiring does (node/cli.py)
-    with tempfile.TemporaryDirectory() as d:
-        BlockStore(d, registry=service.registry).close()
-    return {
-        "service": service.registry,
-        "proof": proof_stage_registry(),
-        "rs": rs_stage_registry(),
-    }
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
 def main() -> int:
-    bad = []
-    total = 0
-    for origin, registry in collect_registries().items():
-        for metric in registry.metrics():
-            total += 1
-            if not getattr(metric, "help", ""):
-                bad.append(f"{origin}:{metric.name}")
-    if bad:
+    from tools.cesslint import load_tree, run_tree
+
+    files, docs = load_tree()
+    kept, _ = run_tree(files, docs, passes=("surface",))
+    kept = [f for f in kept if f.rule == "surface-metrics-help"]
+    if kept:
         print("metrics missing help text:", file=sys.stderr)
-        for name in bad:
-            print(f"  {name}", file=sys.stderr)
+        for f in kept:
+            print(f"  {f.render()}", file=sys.stderr)
         return 1
-    print(f"metrics lint: {total} metrics, all with help text")
+    print("metrics lint: ok (cesslint surface-metrics-help)")
     return 0
 
 
