@@ -138,6 +138,31 @@ def batch_verify(
     return bls_agg.verify_batch_host(triples, seed)
 
 
+def batch_claim_triples(
+    claims: list[Claim],
+) -> tuple[list[tuple[bytes, bytes, bytes]], int]:
+    """Pairing triples for the longest claim PREFIX whose outputs
+    re-derive from their proofs — the batch-import entry point
+    (node/service.py import_batch folds these into one weighted
+    pairing alongside the author/extrinsic signatures).
+
+    A claim whose output does not match its proof must never be
+    silently dropped from the batch: the pairing is the only check
+    that catches a forged proof, so dropping the claim while keeping
+    its block in the batch would let the forgery import.  Truncating
+    at the first bad claim keeps every returned triple aligned with a
+    block the caller will import under the batch verdict; the bad
+    claim's block falls to the per-block path, where
+    classify_claim/verify pin the exact failure.  Returns (triples,
+    prefix_len)."""
+    n = 0
+    for _, _, out, proof in claims:
+        if proof_to_output(proof) != out:
+            break
+        n += 1
+    return [(pk, msg, proof) for pk, msg, _, proof in claims[:n]], n
+
+
 def verify_claims(
     claims: list[Claim], seed: bytes = b"",
     mesh=None, device: bool | None = None,

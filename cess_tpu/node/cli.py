@@ -48,6 +48,7 @@ def _cmd_run(args) -> int:
         spec, authority=args.authority,
         pool_max_count=args.pool_max_count,
         pool_max_bytes=args.pool_max_bytes,
+        import_batch_max=args.import_batch_max,
     )
     service.chaos_mute = bool(args.chaos_mute)
     faults = None
@@ -72,6 +73,7 @@ def _cmd_run(args) -> int:
         print(f"store: data-dir={args.data_dir} "
               f"rung={recovered['rung']} "
               f"replayed={recovered['replayed']} "
+              f"deduped={recovered['deduped']} "
               f"truncated={recovered['truncated']} "
               f"head=#{recovered['head']}", flush=True)
     if args.import_state:
@@ -305,6 +307,10 @@ def build_parser() -> argparse.ArgumentParser:
                           "(flood adds synthetic spam-account load; "
                           "baddisk injects storage faults into "
                           "--data-dir writes)")
+    run.add_argument("--import-batch-max", type=int, default=None,
+                     help="most blocks folded into one weighted import "
+                          "batch pairing (gossip drain, catch-up, "
+                          "journal replay; default 64)")
     run.add_argument("--pool-max-count", type=int, default=None,
                      help="hard tx-pool transaction bound (default 2048)")
     run.add_argument("--pool-max-bytes", type=int, default=None,

@@ -88,6 +88,11 @@ class RpcApi:
                     "pending": pool["pending"], "future": pool["future"],
                 },
                 "bestBlock": best,
+                # pipelined-import backlog (service.import_batch):
+                # gossip blocks queued for the batch drain loop — a
+                # node whose queue grows faster than it drains is
+                # falling behind slot production
+                "importQueue": s.import_queue_depth(),
                 # durable-store health (node/store.py): True while the
                 # last journal/checkpoint write hit an OSError (ENOSPC,
                 # injected storage fault) and the node is running from

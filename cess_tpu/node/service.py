@@ -27,7 +27,8 @@ from __future__ import annotations
 import hashlib
 import threading
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
 from ..chain.runtime import Runtime
@@ -36,6 +37,7 @@ from ..chain import checkpoint
 from ..chain import fees as fees_mod
 from ..chain import offences as offences_mod
 from ..consensus import ClaimError, engine as consensus
+from ..consensus import vrf as vrf_mod
 from ..ops import bls12_381 as bls
 from .chain_spec import ChainSpec, dev_sk
 from .sync import (
@@ -595,6 +597,10 @@ class BlockRecord:
     receipts: list[dict] = field(default_factory=list)
     hash: str = ""
     imported: bool = False  # True when re-executed from a peer block
+    # True when the author/VRF/extrinsic signatures rode a SUCCESSFUL
+    # batch pairing (import_batch); False for serial verification,
+    # including the per-block fallback after a refused batch
+    batch_verified: bool = False
 
 
 # Recent post-state snapshots kept for head-reorg rollback and
@@ -625,6 +631,15 @@ EVENT_SINK_MAX = 50_000
 # deliberately NOT cached: they may succeed on redelivery.
 REJECT_CACHE_MAX = 8192
 
+# Pipelined import queue (gossip-burst / catch-up / journal-replay
+# path): the most blocks whose author + VRF + extrinsic signatures
+# fold into ONE weighted batch pairing (import_batch), mirroring
+# sync.py's SYNC_RANGE_MAX fold, and the bound on the per-hash
+# announce-verdict cache (announcers whose block a concurrent drain
+# already judged read their verdict from here).
+IMPORT_BATCH_MAX = 64
+IMPORT_RESULT_CACHE_MAX = 2048
+
 
 class NodeService:
     """One chain node: Runtime + pool + block authoring + state export.
@@ -643,6 +658,7 @@ class NodeService:
         registry: "m.Registry | None" = None,
         pool_max_count: int | None = None,
         pool_max_bytes: int | None = None,
+        import_batch_max: int | None = None,
     ) -> None:
         self.spec = spec
         self.authority = authority
@@ -746,6 +762,25 @@ class NodeService:
         # still gets.
         self.store = None
 
+        # Pipelined import queue (the decoupled import-queue role,
+        # service.rs:219-584): handle_announce enqueues verified-shape
+        # candidates; exactly one announcer thread at a time becomes
+        # the drainer (_import_draining) and folds the whole queue's
+        # pairings into batches (import_batch), double-buffering the
+        # next batch's pairing on the verifier worker under the
+        # current batch's re-execution.  Everyone else waits on the
+        # condition for its own block's verdict.
+        self.import_batch_max = max(2, import_batch_max
+                                    or IMPORT_BATCH_MAX)
+        self._import_queue: deque = deque()  # guarded-by: _lock
+        self._import_queued: set[str] = set()  # guarded-by: _lock
+        self._import_results: OrderedDict[str, tuple] = OrderedDict()  # guarded-by: _lock
+        self._import_draining = False  # guarded-by: _lock
+        self._import_cv = threading.Condition(self._lock)
+        # lazy 1-worker pool for off-lock batch pairings (host/device
+        # double-buffering); single worker keeps batches ordered
+        self._import_verifier: ThreadPoolExecutor | None = None  # guarded-by: _lock
+
         # Offences bookkeeping (node side): sessions this node already
         # heartbeat for, offence report keys already submitted/gossiped
         # (gossip floods re-deliver each report N-1 times), and the
@@ -812,6 +847,19 @@ class NodeService:
                 ("snapshot", "post-state snapshot + hash"),
             )
         }
+        # Import-pipeline observability: queue depth is the gossip
+        # backlog the drain loop is working off; batch size records how
+        # many blocks each weighted pairing actually folded (1-bucket
+        # observations mean the prefix was unbatchable and fell to the
+        # per-block path).
+        self.m_import_queue = m.Gauge(
+            "cess_import_queue_depth",
+            "gossip blocks waiting in the pipelined import queue", reg)
+        self.m_import_batch = m.Histogram(
+            "cess_import_batch_size",
+            "blocks whose signatures folded into one import batch "
+            "pairing",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128), registry=reg)
         self.m_finality_lag = m.Gauge(
             "cess_finality_lag_blocks",
             "best block minus finalized block", reg)
@@ -1344,6 +1392,7 @@ class NodeService:
     def import_block(
         self, block: Block, sigs_verified: bool = False,
         trace: str | None = None, origin: str = "announce",
+        batch_vrf_msg: bytes | None = None,
     ) -> BlockRecord | None:
         """Verify and re-execute a peer block (the import-queue role).
 
@@ -1368,6 +1417,13 @@ class NodeService:
         path, node/sync.py) skips the pairing work — the caller
         already verified every signature in one weighted batch — but
         every structural and state check still runs.
+        `batch_vrf_msg` (the batched import path, import_batch) is the
+        VRF message whose pairing the batch actually covered: if the
+        message recomputed under the lock at the parent state differs
+        (the epoch context moved between the batch's triple build and
+        this block's turn — an era boundary or a concurrent reorg),
+        sigs_verified is demoted and the per-block pairing runs, so a
+        batch verdict can never vouch for the wrong message.
 
         `trace` is the author-minted trace id from the gossip/catch-up
         envelope (node/tracing.py): the import spans recorded here join
@@ -1387,7 +1443,8 @@ class NodeService:
                   "origin": origin},
         ) as root:
             try:
-                rec = self._import_block_inner(block, sigs_verified)
+                rec = self._import_block_inner(
+                    block, sigs_verified, batch_vrf_msg=batch_vrf_msg)
             except BlockImportError as e:
                 root.tags["rejected"] = str(e)
                 self.m_import_rejected.inc()
@@ -1436,7 +1493,8 @@ class NodeService:
                                       block.slot)
 
     def _import_block_inner(
-        self, block: Block, sigs_verified: bool = False
+        self, block: Block, sigs_verified: bool = False,
+        batch_vrf_msg: bytes | None = None,
     ) -> BlockRecord | None:
         with self._lock:
             try:
@@ -1510,7 +1568,8 @@ class NodeService:
                     raise BlockImportError("non-monotone slot")
                 record = self._verify_and_apply(
                     block, author_verified=author_verified,
-                    sigs_verified=sigs_verified)
+                    sigs_verified=sigs_verified,
+                    batch_vrf_msg=batch_vrf_msg)
             except BlockImportError:
                 if undo is not None:
                     self._reinstate_head(*undo)
@@ -1547,6 +1606,7 @@ class NodeService:
     def _verify_and_apply(  # holds-lock: _lock
         self, block: Block, author_verified: bool = False,
         sigs_verified: bool = False,
+        batch_vrf_msg: bytes | None = None,
     ) -> tuple[BlockRecord, bytes, list]:
         """Slot-claim check + signature batch + deterministic
         re-execution; rolls the runtime back on a post-state mismatch.
@@ -1561,6 +1621,15 @@ class NodeService:
         # (output↔proof binding, threshold/secondary schedule); the
         # proof's pairing joins the weighted batch below.
         vrf_msg = self._check_slot_claim(block)
+        if (sigs_verified and batch_vrf_msg is not None
+                and batch_vrf_msg != vrf_msg):
+            # The batch pairing covered a VRF message sampled before
+            # this block's turn under the lock; the epoch context has
+            # moved since (era boundary rotated by an earlier batch
+            # member, or a concurrent reorg).  The batch verdict is
+            # then vouching for the WRONG message — demote to the
+            # per-block pairing rather than trust it.
+            sigs_verified = False
         try:
             exts = [Extrinsic.from_json(e) for e in block.extrinsics]
         except (KeyError, TypeError, ValueError) as e:
@@ -1662,28 +1731,305 @@ class NodeService:
 
     def handle_announce(self, block_json: dict,
                         trace: str | None = None) -> str:
-        """`sync_announce` intake: import, or catch up on a gap.
-        `trace` is the author's trace-id envelope (telemetry only)."""
+        """`sync_announce` intake: queue for pipelined import, or catch
+        up on a gap.  Concurrent announcers' blocks coalesce in the
+        import queue and one drainer folds their pairings into batches
+        (import_batch); each announcer gets its own block's verdict
+        back.  `trace` is the author's trace-id envelope (telemetry
+        only)."""
         try:
             block = Block.from_json(block_json)
         except (KeyError, TypeError, ValueError) as e:
             raise BlockImportError(f"malformed block: {e!r}")
-        try:
-            rec = self.import_block(block, trace=trace, origin="gossip")
-        except SyncGap:
+        kind, payload = self._queued_import(block, trace)
+        if kind == "gap":
             if self.sync is not None:
                 self.sync.catch_up()
             return "gap"
-        except BlockImportError as e:
+        if kind == "rejected":
             # an unknown parent means the announcer is on another fork —
             # let catch-up walk back to the common ancestor and decide
             # by chain length rather than dropping the peer's chain.
             # (m_import_rejected was already counted by import_block.)
-            if "unknown parent" in str(e) and self.sync is not None:
+            if "unknown parent" in payload and self.sync is not None:
                 self.sync.catch_up()
                 return "fork"
-            raise
-        return "imported" if rec is not None else "known"
+            raise BlockImportError(payload)
+        return "imported" if kind == "imported" else "known"
+
+    # ------------------------------------------- pipelined import queue
+
+    def import_queue_depth(self) -> int:
+        """Blocks waiting in the pipelined import queue (the
+        system_health backlog signal)."""
+        with self._lock:
+            return len(self._import_queue)
+
+    def _era_boundary(self, number: int) -> bool:
+        """True when `number` is the last block the CURRENT epoch
+        context's VRF messages are valid for (rotation happens inside
+        the boundary block, affecting only later claims) — the prefetch
+        gate: pairing the next batch's messages across a boundary would
+        verify soon-to-be-stale messages."""
+        era = getattr(self.rt.config, "era_duration_blocks", 0) or 0
+        return era > 0 and number > 0 and number % era == 0
+
+    def _verifier(self) -> ThreadPoolExecutor:
+        """The (lazy) 1-worker pairing pool: batch k+1's weighted
+        pairing runs here while the import thread re-executes batch k —
+        the chain-plane double-buffering mirror of the fused-verify
+        prefetch worker.  One worker keeps batch verdicts ordered."""
+        with self._lock:
+            if self._import_verifier is None:
+                self._import_verifier = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="import-verify")
+            return self._import_verifier
+
+    def _timed_pairing(self, triples: list, trace: str | None) -> bool:
+        """Runs on the verifier worker: ONE weighted pairing over a
+        whole batch's author + VRF + extrinsic signatures."""
+        from ..ops import bls_agg
+
+        with self.tracer.span(
+            "import.batch_pairing",
+            trace=(trace if tracing.valid_trace_id(trace)
+                   else tracing.mint_trace_id()),
+            tags={"sigs": len(triples)},
+        ), self.m_import_stage["sig_batch"].time():
+            return bls_agg.verify_batch_host(
+                triples, seed=self.genesis.encode())
+
+    def _batch_prefix_locked(  # holds-lock: _lock
+        self, blocks: list[Block], base_n: int,
+    ) -> tuple[int, list, list]:
+        """The batchable prefix of `blocks` atop head number `base_n`:
+        consecutive numbers, capped at import_batch_max and at the next
+        era boundary (inclusive — the sync_block_range rule: VRF
+        messages built from the CURRENT epoch context are valid up to
+        and including the boundary block), stopping at the first block
+        whose triples don't build or whose VRF output does not re-derive
+        from its proof (vrf.batch_claim_triples — a bad claim must meet
+        the per-block path, never be dropped from the pairing).
+
+        Returns (n, triples, msgs): n ≥ 2 blocks covered by `triples`
+        (one weighted pairing), with `msgs` their VRF messages for the
+        per-block recheck; n < 2 means the prefix is not batchable."""
+        n_contig = 0
+        for want_n, blk in zip(range(base_n + 1, base_n + 1 + len(blocks)),
+                               blocks):
+            if blk.number != want_n:
+                break
+            n_contig += 1
+        cap = min(n_contig, self.import_batch_max)
+        era = getattr(self.rt.config, "era_duration_blocks", 0) or 0
+        if era > 0:
+            boundary = (base_n + 1) + (-(base_n + 1)) % era
+            cap = min(cap, boundary - base_n)
+        if cap < 2:
+            return 0, [], []
+        groups: list[tuple[list, tuple, bytes]] = []
+        for blk in blocks[:cap]:
+            try:
+                pk = self.keys.get(blk.author)
+                if pk is None or not blk.signature:
+                    break
+                msg = consensus.slot_message(self.genesis, self.rt.rrsc,
+                                             blk.slot)
+                entry = [(pk, blk.signing_payload(self.genesis),
+                          bytes.fromhex(blk.signature))]
+                for e in blk.extrinsics:
+                    ext = Extrinsic.from_json(e)
+                    epk = self.keys.get(ext.signer)
+                    if epk is None or not ext.signature:
+                        raise ValueError("unknown extrinsic signer")
+                    entry.append((epk, ext.payload(self.genesis),
+                                  bytes.fromhex(ext.signature)))
+                claim = (pk, msg, bytes.fromhex(blk.vrf_output),
+                         bytes.fromhex(blk.vrf_proof))
+            except (KeyError, TypeError, ValueError):
+                break
+            groups.append((entry, claim, msg))
+        vrf_triples, ok = vrf_mod.batch_claim_triples(
+            [claim for _, claim, _ in groups])
+        n = min(len(groups), ok)
+        if n < 2:
+            return 0, [], []
+        triples: list = []
+        for entry, _, _ in groups[:n]:
+            triples.extend(entry)
+        triples.extend(vrf_triples[:n])
+        return n, triples, [msg for _, _, msg in groups[:n]]
+
+    def _stage_batch(self, blocks: list[Block], i: int, base_n: int,
+                     trace: str | None) -> dict | None:
+        """Stage the batch starting at blocks[i] against head number
+        `base_n`: sample the batchable prefix under the lock and submit
+        its pairing to the verifier worker.  base_n is the CURRENT head
+        for the first batch and the staged end of batch k for the
+        prefetched batch k+1 (import_batch discards the prefetch if
+        batch k lands anywhere else).  Returns the dict the drain loop
+        consumes (cnt=1, fut=None when unbatchable — the per-block
+        path), or None past the end."""
+        if i >= len(blocks):
+            return None
+        with self._lock:
+            n, triples, msgs = self._batch_prefix_locked(
+                blocks[i:], base_n)
+        if n < 2:
+            return {"i": i, "cnt": 1, "msgs": [], "fut": None,
+                    "end": None}
+        fut = self._verifier().submit(self._timed_pairing, triples,
+                                      trace)
+        return {"i": i, "cnt": n, "msgs": msgs, "fut": fut,
+                "end": base_n + n}
+
+    def import_batch(
+        self, blocks: list[Block], traces: list | None = None,
+        origin: str = "batch",
+    ) -> list[tuple[str, object]]:
+        """Import consecutive peer blocks with their pairings folded
+        into weighted batches (the pipelined import path shared by
+        gossip drain, range catch-up, and journal replay).  While batch
+        k's blocks re-execute on this thread, batch k+1's pairing runs
+        on the verifier worker (prefetch skipped across era boundaries
+        — the epoch context rotates inside them).  A failed batch
+        pairing falls back to per-block verification for exactly those
+        blocks, isolating the bad one without poisoning siblings; state
+        hashes are checked per block either way, so the outcome is
+        bit-identical to the serial path.
+
+        Returns one outcome per block, aligned with `blocks`:
+        ("imported", BlockRecord) | ("known", None) | ("gap", None) |
+        ("rejected", reason-str)."""
+        outcomes: list[tuple[str, object]] = []
+        if not blocks:
+            return outcomes
+        trace = None
+        if traces:
+            for t in traces:
+                if tracing.valid_trace_id(t):
+                    trace = t
+                    break
+        staged = self._stage_batch(blocks, 0, self.head_number(), trace)
+        while staged is not None:
+            i, cnt, fut = staged["i"], staged["cnt"], staged["fut"]
+            nxt = None
+            if (fut is not None and i + cnt < len(blocks)
+                    and not self._era_boundary(staged["end"])):
+                # double-buffer: submit batch k+1's pairing before
+                # executing batch k — the single verifier worker runs
+                # it while this thread re-executes batch k's blocks
+                nxt = self._stage_batch(blocks, i + cnt, staged["end"],
+                                        trace)
+            verified = bool(fut.result()) if fut is not None else False
+            if fut is not None:
+                self.m_import_batch.observe(cnt)
+            with self.tracer.span(
+                "import.batch",
+                trace=(trace if tracing.valid_trace_id(trace)
+                       else tracing.mint_trace_id()),
+                tags={"origin": origin, "blocks": cnt,
+                      "batched": verified},
+            ):
+                for j in range(i, i + cnt):
+                    tr = (traces[j] if traces and j < len(traces)
+                          else None)
+                    try:
+                        rec = self.import_block(
+                            blocks[j], sigs_verified=verified, trace=tr,
+                            origin=origin,
+                            batch_vrf_msg=(staged["msgs"][j - i]
+                                           if verified else None))
+                    except SyncGap:
+                        outcomes.append(("gap", None))
+                    except BlockImportError as e:
+                        outcomes.append(("rejected", str(e)))
+                    else:
+                        if rec is not None:
+                            rec.batch_verified = verified
+                        outcomes.append(
+                            ("imported", rec) if rec is not None
+                            else ("known", None))
+            if nxt is not None and self.head_number() != staged["end"]:
+                # batch k did not land where the prefetch assumed (a
+                # reject/gap inside it, or a concurrent import): the
+                # prefetched pairing covered the wrong context —
+                # discard it and re-stage from the actual head
+                if nxt["fut"] is not None:
+                    nxt["fut"].cancel()
+                nxt = None
+            staged = nxt if nxt is not None else self._stage_batch(
+                blocks, i + cnt, self.head_number(), trace)
+        return outcomes
+
+    def _queued_import(self, block: Block,
+                       trace: str | None) -> tuple[str, object]:
+        """Gossip-path import through the pipelined queue: enqueue,
+        then either become the drainer or wait for a concurrent drain
+        to judge our block.  Returns the import_batch outcome tuple for
+        THIS block."""
+        try:
+            h = block.hash(self.genesis)
+        except ValueError:
+            raise BlockImportError("undecodable signature")
+        with self._lock:
+            if h in self.block_store:
+                return "known", None
+            # a stale verdict must not answer a fresh announce (the
+            # parent may have arrived since a past rejection)
+            self._import_results.pop(h, None)
+            if h not in self._import_queued:
+                self._import_queued.add(h)
+                self._import_queue.append((h, block, trace))
+                self.m_import_queue.set(len(self._import_queue))
+        while True:
+            with self._lock:
+                got = self._import_results.get(h)
+                if got is not None:
+                    return got
+                if not self._import_draining:
+                    self._import_draining = True
+                    break
+                # a drain is running; it notifies when verdicts land.
+                # Timed wait: if the drainer judged our block between
+                # our enqueue and this wait, the re-check above finds
+                # the verdict; the timeout only bounds lost-notify
+                # corner cases.
+                self._import_cv.wait(0.5)
+        try:
+            self._drain_import_queue()
+        finally:
+            with self._lock:
+                self._import_draining = False
+                self._import_cv.notify_all()
+        with self._lock:
+            return self._import_results.get(h, ("known", None))
+
+    def _drain_import_queue(self) -> None:
+        """The drain loop (exactly one thread at a time,
+        _import_draining): snapshot the whole queue, run it through
+        import_batch sorted by number (concurrent announcers enqueue
+        out of order; a contiguous run is what batches), publish
+        per-hash verdicts, repeat until the queue is empty."""
+        while True:
+            with self._lock:
+                if not self._import_queue:
+                    return
+                batch = list(self._import_queue)
+                self._import_queue.clear()
+                for h, _, _ in batch:
+                    self._import_queued.discard(h)
+                self.m_import_queue.set(0)
+            batch.sort(key=lambda e: e[1].number)
+            outcomes = self.import_batch(
+                [b for _, b, _ in batch],
+                traces=[t for _, _, t in batch], origin="gossip")
+            with self._lock:
+                for (h, _, _), out in zip(batch, outcomes):
+                    self._import_results[h] = out
+                while len(self._import_results) > IMPORT_RESULT_CACHE_MAX:
+                    self._import_results.popitem(last=False)
+                self._import_cv.notify_all()
 
     def reorg_to(self, ancestor_number: int) -> bool:
         """Rewind the chain to `ancestor_number` (longest-chain fork
@@ -2207,6 +2553,11 @@ class NodeService:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        with self._lock:
+            verifier = self._import_verifier
+            self._import_verifier = None
+        if verifier is not None:
+            verifier.shutdown(wait=False)
 
     # ------------------------------------------------------ state io
 

@@ -186,6 +186,11 @@ class BlockStore:
             "cess_store_replay_skipped",
             "journal records rejected by import verification at "
             "recovery (tampered or orphaned by a reorg)", reg)
+        self.m_replay_dedup = m.Counter(
+            "cess_store_replay_deduped",
+            "journal block records skipped at recovery because the "
+            "restored checkpoint already covers them (at or below "
+            "the restored head)", reg)
         self.m_truncated = m.Counter(
             "cess_store_truncated_records",
             "journal truncations at a checksum-invalid or short "
@@ -497,13 +502,48 @@ class BlockStore:
                 return str(entry["file"]), head.number
         return None
 
-    def _recover_journal(self, service) -> tuple[int, int]:
+    def _recover_journal(self, service) -> tuple[int, int, int]:
         """Rung 2: replay every intact journal record through the
         deterministic import path; truncate the journal at the first
         torn record (and drop later segments — continuity is gone).
-        Returns (replayed, truncated)."""
+
+        Block records ride the BATCHED import path
+        (service.import_batch): consecutive records fold their author +
+        VRF + extrinsic pairings into one weighted batch instead of
+        paying the serial pairing per record after every kill -9.
+        Records at or below the restored head are skipped BEFORE the
+        batch is built (deduped — a block in both the newest checkpoint
+        and the journal tail must not pay an import at all); the flush
+        barrier before every justification record preserves the
+        journal's block→finality ordering.  Returns (replayed,
+        truncated, deduped)."""
         replayed = 0
         truncated = 0
+        deduped = 0
+        batch: list[tuple[Block, int]] = []
+
+        def flush() -> None:
+            nonlocal replayed
+            if not batch:
+                return
+            outcomes = service.import_batch(
+                [b for b, _ in batch], origin="journal")
+            for (blk, seq), (kind, _) in zip(batch, outcomes):
+                if kind in ("rejected", "gap"):
+                    # verification rejected it (tampered record, or a
+                    # fork branch orphaned by a reorg whose winner
+                    # follows): skip — the winning chain's records
+                    # still chain onto the head.  A rejected record
+                    # must not drive segment pruning either.
+                    self.m_replay_skipped.inc()
+                    continue
+                self._seg_max[seq] = max(self._seg_max.get(seq, 0),
+                                         blk.number)
+                if kind == "imported":
+                    self.m_replay.inc()
+                    replayed += 1
+            del batch[:]
+
         segs = self._segments()
         for i, (seq, path) in enumerate(segs):
             try:
@@ -514,9 +554,24 @@ class BlockStore:
                 data = b""
             bodies, valid_len = scan_records(data)
             for body in bodies:
-                got = self._replay_record(service, body, seq)
-                if got:
-                    replayed += 1
+                kind, payload = self._parse_record(body)
+                if kind == "block":
+                    if payload.number <= service.head_number():
+                        # covered by the restored checkpoint (or an
+                        # earlier batch): never reaches import
+                        self.m_replay_dedup.inc()
+                        deduped += 1
+                        self._seg_max[seq] = max(
+                            self._seg_max.get(seq, 0), payload.number)
+                        continue
+                    batch.append((payload, seq))
+                elif kind == "just":
+                    flush()
+                    try:
+                        service.handle_justification(payload)
+                    except (KeyError, TypeError, ValueError):
+                        self.m_replay_skipped.inc()
+            flush()
             if valid_len < len(data):
                 truncated += 1
                 self.m_truncated.inc()
@@ -532,47 +587,32 @@ class BlockStore:
                     except OSError:
                         pass
                 break
-        return replayed, truncated
+        return replayed, truncated, deduped
 
-    def _replay_record(self, service, body: bytes, seq: int) -> bool:
+    def _parse_record(self, body: bytes):
+        """One journal record body → ("block", Block) | ("just",
+        Justification) | (None, None); malformed records count as
+        skipped."""
         try:
             rec = json.loads(body)
             kind = rec.get("t")
         except (ValueError, AttributeError):
             self.m_replay_skipped.inc()
-            return False
+            return None, None
         if kind == "just":
             try:
-                service.handle_justification(
-                    Justification.from_json(rec["just"]))
+                return "just", Justification.from_json(rec["just"])
             except (KeyError, TypeError, ValueError):
                 self.m_replay_skipped.inc()
-            return False
+                return None, None
         if kind != "block":
             self.m_replay_skipped.inc()
-            return False
+            return None, None
         try:
-            block = Block.from_json(rec["block"])
+            return "block", Block.from_json(rec["block"])
         except (KeyError, TypeError, ValueError):
             self.m_replay_skipped.inc()
-            return False
-        try:
-            got = service.import_block(block, origin="journal")
-        except BlockImportError:
-            # verification rejected it (tampered record, or a fork
-            # branch orphaned by a reorg whose winner follows): skip —
-            # the winning chain's records still chain onto the head
-            self.m_replay_skipped.inc()
-            return False
-        except (SyncGap, ValueError, KeyError, TypeError):
-            self.m_replay_skipped.inc()
-            return False
-        self._seg_max[seq] = max(self._seg_max.get(seq, 0),
-                                 block.number)
-        if got is None:
-            return False  # already level (stale/known record)
-        self.m_replay.inc()
-        return True
+            return None, None
 
     def recover(self, service) -> dict:
         """The startup recovery ladder.  Runs BEFORE the sync loop
@@ -583,7 +623,7 @@ class BlockStore:
         with self._lock:
             self._replaying = True
             summary = {"rung": "cold", "checkpoint": None,
-                       "replayed": 0, "truncated": 0}
+                       "replayed": 0, "truncated": 0, "deduped": 0}
             try:
                 got = self._recover_checkpoint(service)
                 if got is not None:
@@ -591,9 +631,11 @@ class BlockStore:
                     summary["checkpoint"] = got[0]
                     self._ckpt_number = got[1]
                     self.m_recoveries.inc("checkpoint")
-                replayed, truncated = self._recover_journal(service)
+                replayed, truncated, deduped = self._recover_journal(
+                    service)
                 summary["replayed"] = replayed
                 summary["truncated"] = truncated
+                summary["deduped"] = deduped
                 if replayed:
                     summary["rung"] = ("checkpoint+replay"
                                        if got is not None else "replay")

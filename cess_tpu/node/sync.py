@@ -698,10 +698,6 @@ class SyncManager:
             return got
 
     def _batch_import_inner(self, host: str, port: int, gap: int) -> int:
-        from ..consensus import engine
-        from ..ops import bls_agg as _agg
-        from .service import Extrinsic
-
         s = self.service
         start = s.head_number() + 1
         count = min(gap, SYNC_RANGE_MAX)
@@ -718,63 +714,54 @@ class SyncManager:
             return -2
         if not isinstance(items, list) or len(items) < 2:
             return 0
-        triples = []
-        blocks: list[tuple[Block, dict]] = []
+        blocks: list[Block] = []
+        traces: list = []
+        justs: list = []
         try:
-            with s._lock:
-                if s.head_number() + 1 != start:
-                    # a concurrent gossip import advanced the head while
-                    # we fetched — the epoch context sampled below could
-                    # postdate an era boundary the range precedes, so an
-                    # honest range would fail verification.  Retryable.
-                    return -2
-                for want_n, d in enumerate(items, start):
-                    blk = Block.from_json(d["block"])
-                    if blk.number != want_n:
-                        return 0
-                    pk = s.keys.get(blk.author)
-                    if pk is None or not blk.signature:
-                        return 0
-                    msg = engine.slot_message(s.genesis, s.rt.rrsc,
-                                              blk.slot)
-                    triples.append(
-                        (pk, blk.signing_payload(s.genesis),
-                         bytes.fromhex(blk.signature)))
-                    triples.append(
-                        (pk, msg, bytes.fromhex(blk.vrf_proof)))
-                    for e in blk.extrinsics:
-                        ext = Extrinsic.from_json(e)
-                        epk = s.keys.get(ext.signer)
-                        if epk is None:
-                            return 0
-                        triples.append((
-                            epk, ext.payload(s.genesis),
-                            bytes.fromhex(ext.signature),
-                        ))
-                    blocks.append((blk, d))
+            for want_n, d in enumerate(items, start):
+                blk = Block.from_json(d["block"])
+                if blk.number != want_n:
+                    return 0
+                blocks.append(blk)
+                traces.append(d.get("trace"))
+                justs.append(d.get("justification"))
         except (KeyError, TypeError, ValueError):
             return 0
-        if not _agg.verify_batch_host(triples, seed=s.genesis.encode()):
-            return 0
+        if s.head_number() + 1 != start:
+            # a concurrent gossip import advanced the head while we
+            # fetched — the range no longer sits on our head, and the
+            # epoch context the batch would sample could postdate an
+            # era boundary the range precedes.  Retryable.
+            return -2
+        # The service's pipelined batch path does the fold: triples
+        # built under the lock against the parent state (head-motion
+        # safe via the per-block VRF-message recheck), one weighted
+        # pairing per import_batch_max prefix, double-buffered with
+        # re-execution, per-block fallback on a refused pairing.
+        outcomes = s.import_batch(blocks, traces=traces,
+                                  origin="catchup-batch")
         imported = 0
-        for blk, d in blocks:
-            try:
-                rec = s.import_block(blk, sigs_verified=True,
-                                     trace=d.get("trace"),
-                                     origin="catchup-batch")
-            except (BlockImportError, SyncGap, KeyError, ValueError,
-                    TypeError, AttributeError):
+        for (kind, payload), just in zip(outcomes, justs):
+            if kind in ("rejected", "gap"):
+                # a refusal (or a gap a rejection opened) ends this
+                # range; 0 with no progress drops the caller to the
+                # per-block path, which pins the exact failure
                 break
-            if d.get("justification"):
+            if just:
                 try:
                     s.handle_justification(
-                        Justification.from_json(d["justification"])
+                        Justification.from_json(just)
                     )
                 except (KeyError, TypeError, ValueError):
                     pass
-            if rec is not None:
+            if kind == "imported":
                 imported += 1
-                self.batched_imports += 1
+                # count only blocks whose pairings actually folded —
+                # a range whose batch pairing was refused imports its
+                # honest prefix through the serial fallback, and that
+                # must not read as "rode the batch"
+                if getattr(payload, "batch_verified", False):
+                    self.batched_imports += 1
         return imported
 
     def _pull_finality(self, host: str, port: int, status: dict) -> None:

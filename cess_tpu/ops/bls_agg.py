@@ -95,7 +95,15 @@ def _weighted_batch_check(
         return True
     try:
         sig_pts = [G1Point.from_bytes(sig) for _, _, sig in triples]
-        pk_pts = {pk: G2Point.from_bytes(pk) for pk, _, _ in triples}
+        # decompress each DISTINCT key once: batches are signed under a
+        # handful of authority keys, and G2 decompression (~46 ms of
+        # sqrt + subgroup ladder) per TRIPLE was the dominant cost of a
+        # 64-block import batch — a dict comprehension pays it before
+        # the dict dedups
+        pk_pts: dict[bytes, G2Point] = {}
+        for pk, _, _ in triples:
+            if pk not in pk_pts:
+                pk_pts[pk] = G2Point.from_bytes(pk)
     except ValueError:
         return False
     rhos = batch_weights(agg_transcript(seed, triples), len(triples))

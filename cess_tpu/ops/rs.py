@@ -662,6 +662,7 @@ class RSStream:
         donate = self.present is not None
         t0 = t_start
         outs = []
+        pulled = []
         for off in range(0, n, tile):
             chunk = data[:, off : off + tile]
             if chunk.shape[1] != tile:  # padded tail: one shape only
@@ -681,9 +682,22 @@ class RSStream:
                 out = code._kernel(mat_dev, dev, donate=donate)
             outs.append(out)
             t0 = self._mark("matmul", t0)
-        jax.block_until_ready(outs)
+            if len(outs) > 1:
+                # pull tile t-1's result under tile t's compute: the
+                # device→host copy of an already-finished tile overlaps
+                # the in-flight matmul instead of queueing serially
+                # behind the final drain.  The pull still counts as
+                # `unpack` — overlapped or not, it is device→host
+                # reassembly time (keeps the stage histogram honest).
+                # cesslint: allow[host-sync] pulls the PREVIOUS tile,
+                # already computed, while tile t is still in flight
+                pulled.append(np.asarray(outs[-2]))
+                outs[-2] = None
+                t0 = self._mark("unpack", t0)
+        jax.block_until_ready(outs[-1])
         t0 = self._mark("dispatch_wait", t0)
-        res = np.concatenate([np.asarray(o) for o in outs], axis=1)[:, :n]
+        pulled.append(np.asarray(outs[-1]))
+        res = np.concatenate(pulled, axis=1)[:, :n]
         self._mark("unpack", t0)
         self._account(data.nbytes, t_start)
         return res
@@ -714,6 +728,7 @@ class RSStream:
         batch = batch[idx, : code.k]  # group gather = host pack work
         b = batch.shape[0]
         outs = []
+        pulled = []
         for off in range(0, b, slab):
             chunk = batch[off : off + slab]
             if chunk.shape[0] != slab:  # padded tail slab: one shape
@@ -731,9 +746,19 @@ class RSStream:
                 )
             )
             t0 = self._mark("matmul", t0)
-        jax.block_until_ready(outs)
+            if len(outs) > 1:
+                # pull slab t-1's result under slab t's compute (see
+                # RSStream.run): overlapped device→host copies still
+                # accrue to `unpack`
+                # cesslint: allow[host-sync] pulls the PREVIOUS slab,
+                # already computed, while slab t is still in flight
+                pulled.append(np.asarray(outs[-2]))
+                outs[-2] = None
+                t0 = self._mark("unpack", t0)
+        jax.block_until_ready(outs[-1])
         t0 = self._mark("dispatch_wait", t0)
-        got = np.concatenate([np.asarray(o) for o in outs], axis=0)[:b]
+        pulled.append(np.asarray(outs[-1]))
+        got = np.concatenate(pulled, axis=0)[:b]
         out[idx] = got
         self._mark("unpack", t0)
 

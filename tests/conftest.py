@@ -95,6 +95,15 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "import_pipeline: pipelined block-import suite (tests/"
+        "test_import_pipeline.py — 256-block batched-vs-serial bit-"
+        "identity, announce-queue coalescing, bad-block isolation "
+        "inside a batch, equivocation on the queued gossip path, "
+        "batched+deduped journal replay) — CI runs these as their own "
+        "fast gate",
+    )
+    config.addinivalue_line(
+        "markers",
         "cesslint: static-analysis suite (tests/test_cesslint.py — "
         "per-rule fixtures, pragma/baseline mechanics, the self-run "
         "over the real tree) — CI runs these as their own fast gate, "

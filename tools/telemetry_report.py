@@ -264,7 +264,11 @@ class FleetCollector:
             for t in traces:
                 if not isinstance(t, dict):
                     continue
-                if t.get("root") in ("block.author", "block.import") \
+                # import.batch: the pipelined gossip drain wraps a
+                # block's import spans, so on importers the block's
+                # trace roots at the batch span, not block.import
+                if t.get("root") in ("block.author", "block.import",
+                                     "import.batch") \
                         and t.get("traceId"):
                     trace_nodes.setdefault(t["traceId"], set()).add(label)
         stitched = sum(1 for nodes in trace_nodes.values()
