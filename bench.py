@@ -267,6 +267,91 @@ def bench_import(n_blocks: int) -> dict:
     }
 
 
+# ---------------------------------------------------------------- state
+
+
+def bench_state(n_accounts: int) -> dict:
+    """Per-block state commitment A/B at an `n_accounts`-account state:
+    incremental trie rehash (chain/state.py StateDB — rehash only the
+    paths a block touched) vs the pre-v7 cost model (full canonical
+    re-encode + root, chain/checkpoint.py snapshot_and_hash — what
+    every committed block used to pay).  Pure host work (blake2b +
+    codec, no device), so the numbers are honest on any platform.
+
+    Each rep applies a 1-transfer block to the big state, times the
+    incremental commit (root + delta record — everything the per-block
+    path persists now), then times the full re-encode of the SAME
+    post-state and asserts the two roots are BIT-IDENTICAL — the A/B
+    never drifts from the oracle it is racing."""
+    from cess_tpu.chain import checkpoint
+    from cess_tpu.chain.runtime import Runtime
+    from cess_tpu.chain.state import AccountData, StateDB, encode_delta
+    from cess_tpu.node.sync import canonical_json
+
+    reps = max(1, int(os.environ.get("BENCH_STATE_REPS", "3")))
+    rt = Runtime()
+    t0 = time.perf_counter()
+    accounts = rt.state.balances.accounts
+    for i in range(n_accounts):
+        accounts[f"bench-{i:07d}"] = AccountData(free=1_000_000)
+    rt.state.balances.total_issuance += n_accounts * 1_000_000
+    gen_s = time.perf_counter() - t0
+    statedb = StateDB(rt)
+    t0 = time.perf_counter()
+    statedb.rebase()
+    build_s = time.perf_counter() - t0
+    log(f"state chaingen: {n_accounts} accounts in {gen_s:.2f}s; "
+        f"full trie build {build_s:.2f}s")
+
+    incr_runs, full_runs, delta_sizes = [], [], []
+    for rep in range(reps):
+        # the 1-tx block: one transfer + the block housekeeping
+        rt.next_block()
+        rt.state.balances.transfer(
+            f"bench-{rep:07d}", f"bench-{rep + 1:07d}", 7)
+        rt.state.nonces[f"bench-{rep:07d}"] = rep + 1
+        t0 = time.perf_counter()
+        root_hex, delta = statedb.commit()
+        record = canonical_json({"delta": encode_delta(delta)})
+        incr_runs.append(time.perf_counter() - t0)
+        delta_sizes.append(len(delta))
+        t0 = time.perf_counter()
+        blob, full_hex = checkpoint.snapshot_and_hash(rt)
+        full_runs.append(time.perf_counter() - t0)
+        assert full_hex == root_hex, (
+            f"rep {rep}: incremental root {root_hex} != "
+            f"full-rebuild root {full_hex}")
+        assert len(record) < len(blob), "delta record outgrew the blob"
+    incr_med, incr_spread = _median_spread(incr_runs)
+    full_med, full_spread = _median_spread(full_runs)
+    log(f"state before (full re-encode + root per block): median "
+        f"{full_med:.3f}s (spread {full_spread:.3f}s)")
+    log(f"state after (incremental trie commit, "
+        f"{delta_sizes[0]} leaves/block): median {incr_med * 1000:.2f}ms "
+        f"(spread {incr_spread * 1000:.2f}ms, "
+        f"{full_med / incr_med:.0f}x)")
+
+    return {
+        "accounts": n_accounts,
+        "reps": reps,
+        "txs_per_block": 1,
+        "chaingen_s": round(gen_s, 2),
+        "full_trie_build_s": round(build_s, 2),
+        "before_full_reencode": {
+            "median_s": round(full_med, 3),
+            "spread_s": round(full_spread, 3),
+            "runs_s": [round(t, 3) for t in full_runs],
+        },
+        "after_incremental": {
+            "median_s": round(incr_med, 6),
+            "spread_s": round(incr_spread, 6),
+            "runs_s": [round(t, 6) for t in incr_runs],
+            "dirty_leaves_per_block": delta_sizes,
+        },
+        "speedup": round(full_med / incr_med, 1),
+    }
+
+
 # ---------------------------------------------------------------- verify
 
 
@@ -401,6 +486,21 @@ def main() -> None:
             "platform": jax.default_backend(),
             "vs_baseline": None,
             "import": imp,
+        }))
+        return
+    if os.environ.get("BENCH_ONLY", "") == "state":
+        # state-commitment A/B (host blake2b + codec only — honest on
+        # any platform, so no vs_baseline ratio is claimed)
+        st = bench_state(
+            max(2, int(os.environ.get("BENCH_STATE_ACCOUNTS",
+                                      "1000000"))))
+        print(json.dumps({
+            "metric": f"state_root_{st['accounts']}acct_incremental_s",
+            "value": st["after_incremental"]["median_s"],
+            "unit": "s",
+            "platform": jax.default_backend(),
+            "vs_baseline": None,
+            "state": st,
         }))
         return
     n_proofs = int(os.environ.get("BENCH_PROOFS", "1024"))

@@ -11,9 +11,12 @@ the equivalents for the framework's in-memory runtime:
    pallet's storage (sorted mappings, tuple/list distinguished, closed
    under the value types the pallets use).  Two runtimes that executed
    the same extrinsics encode identically, byte for byte.
- * `state_hash(rt)` — sha256 of the encoding: the replay-determinism
+ * `state_hash(rt)` — the sparse-Merkle root over the keyed leaves of
+   that encoding (chain/smt.py, `state_leaves`): the replay-determinism
    anchor (same genesis + same extrinsics ⇒ same hash), asserted in
-   tests/test_checkpoint.py.
+   tests/test_checkpoint.py.  This full rebuild is the bit-identity
+   ORACLE for the incremental root the node maintains per block
+   (chain/state.py StateDB — O(touched) instead of O(N)).
  * `snapshot(rt)` / `restore(rt, blob)` — ExportState/warp-sync shape.
    The blob is a VERSIONED header (magic + format version) over the
    canonical encoding: a pure data format with its own decoder — no
@@ -42,6 +45,8 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 from typing import Any
+
+from . import smt
 
 _PALLETS = (
     "state",
@@ -120,13 +125,25 @@ def _is_data(value: Any) -> bool:
     return False
 
 
-def _object_state(obj: Any, where: str) -> dict[str, Any]:
+def _object_state(
+    obj: Any, where: str,
+    skip: "set[tuple[str, str]] | frozenset" = frozenset(),
+) -> dict[str, Any]:
     """The data attributes of a pallet-like object.  Loud on anything
-    that is neither data nor a recognized structural reference."""
+    that is neither data nor a recognized structural reference.  `skip`
+    holds (pallet, dotted-attr) surfaces the caller tracks elsewhere
+    (StateDB's write-through maps): they are dropped BEFORE the _is_data
+    walk — validating a million-entry map the caller will discard is
+    what made the per-commit compare-scan O(N)."""
     out = {}
+    pallet, _, parent = where.partition(".")
     for name, value in vars(obj).items():
         if (name in _WIRING_FIELDS or name in _OFFCHAIN_FIELDS
                 or f"{where}.{name}" in _EXCLUDED_PATHS):
+            continue
+        if skip and (
+            pallet, f"{parent}.{name}" if parent else name
+        ) in skip:
             continue
         if _is_data(value):
             out[name] = value
@@ -136,7 +153,7 @@ def _object_state(obj: Any, where: str) -> dict[str, Any]:
             out[name] = (
                 "__nested__",
                 type(value).__name__,
-                _object_state(value, f"{where}.{name}"),
+                _object_state(value, f"{where}.{name}", skip),
             )
         else:
             raise TypeError(
@@ -147,10 +164,120 @@ def _object_state(obj: Any, where: str) -> dict[str, Any]:
     return out
 
 
-def _extract(rt) -> dict[str, dict[str, Any]]:
+def _extract(
+    rt, skip: "set[tuple[str, str]] | frozenset" = frozenset()
+) -> dict[str, dict[str, Any]]:
     return {
-        name: _object_state(getattr(rt, name), name) for name in _PALLETS
+        name: _object_state(getattr(rt, name), name, skip)
+        for name in _PALLETS
     }
+
+
+# ------------------------------------------------------------ keyed leaves
+#
+# The sparse-Merkle state commitment (chain/smt.py) hashes the SAME
+# extracted surfaces, cut into keyed leaves: most pallet attributes are
+# one leaf each (their canonical encoding is the leaf value), but the
+# maps in KEYED_MAPS — the surfaces that grow with usage and that
+# stateless clients read — get ONE LEAF PER ENTRY, so touching one
+# account re-hashes one path instead of re-encoding a million, and an
+# account/file/deal read is provable on its own.
+
+# (pallet, attr) map attributes committed entry-by-entry.  Membership is
+# CONSENSUS-CRITICAL: moving a map in or out changes every root.
+KEYED_MAPS = {
+    ("state", "balances.accounts"),
+    ("state", "nonces"),
+    ("file_bank", "deal_map"),
+    ("file_bank", "file"),
+}
+
+
+def canon_bytes(value: Any) -> bytes:
+    """One value through the canonical codec."""
+    out: list[bytes] = []
+    _canon(value, out)
+    return b"".join(out)
+
+
+def decode_value(enc: bytes) -> Any:
+    """Inverse of canon_bytes (exactly one value, no trailing bytes)."""
+    reader = _Reader(enc, _dataclass_registry())
+    value = reader.read()
+    if reader.off != len(enc):
+        raise ValueError("trailing bytes in encoded value")
+    return value
+
+
+def leaf_label(pallet: str, attr: str) -> bytes:
+    return f"{pallet}:{attr}".encode()
+
+
+def _flatten_fields(
+    pallet: str,
+    prefix: str,
+    fields: dict[str, Any],
+    out: dict[bytes, tuple[str, str, bytes | None, bytes]],
+    skip: set[tuple[str, str]],
+) -> None:
+    for name, value in fields.items():
+        attr = f"{prefix}{name}"
+        if (
+            isinstance(value, (tuple, list))
+            and len(value) == 3
+            and value[0] == "__nested__"
+        ):
+            _flatten_fields(pallet, f"{attr}.", value[2], out, skip)
+            continue
+        if (pallet, attr) in skip:
+            continue
+        label = leaf_label(pallet, attr)
+        if (pallet, attr) in KEYED_MAPS and isinstance(value, dict):
+            for k, v in value.items():
+                kenc = canon_bytes(k)
+                out[smt.key_path(label, kenc)] = (
+                    pallet, attr, kenc, canon_bytes(v),
+                )
+        else:
+            out[smt.key_path(label)] = (pallet, attr, None, canon_bytes(value))
+
+
+def state_leaves(
+    rt=None,
+    extract: dict[str, dict[str, Any]] | None = None,
+    skip: set[tuple[str, str]] = frozenset(),
+) -> dict[bytes, tuple[str, str, bytes | None, bytes]]:
+    """Keyed-leaf view of the chain state: tree path → (pallet, attr,
+    map-key encoding | None, value encoding).  Accepts either a live
+    runtime or an already-decoded payload dict (blob verification)."""
+    if extract is None:
+        extract = _extract(rt, skip=set(skip))
+    out: dict[bytes, tuple[str, str, bytes | None, bytes]] = {}
+    for pallet, fields in extract.items():
+        _flatten_fields(pallet, "", fields, out, set(skip))
+    return out
+
+
+def _leaves_root_hex(
+    leaves: dict[bytes, tuple[str, str, bytes | None, bytes]]
+) -> str:
+    tree = smt.SparseMerkleTree({p: m[3] for p, m in leaves.items()})
+    return tree.root().hex()
+
+
+def verify_read(
+    root_hex: str, pallet: str, attr: str, proof_wire: dict, key=None
+) -> tuple[bool, Any]:
+    """STATELESS read verification: check a served proof against a
+    (justified) state root and return (present, decoded value) — no
+    runtime, no tree, no local state.  Raises smt.ProofError on any
+    proof that does not commit to the root."""
+    label = leaf_label(pallet, attr)
+    path = smt.key_path(label, b"" if key is None else canon_bytes(key))
+    present, enc = smt.verify_proof(
+        bytes.fromhex(root_hex), path, smt.Proof.from_wire(proof_wire)
+    )
+    return present, decode_value(enc) if present else None
 
 
 def _apply(obj: Any, data: dict[str, Any]) -> None:
@@ -334,6 +461,12 @@ def _dataclass_registry() -> dict[str, type]:
 # v6: the fees pallet entered the replicated state (chain/fees.py —
 #     per-block fee escrow, lifetime fee totals, per-author payout
 #     ledger for the 20/80 treasury/author split).
+# v7: the state hash became the sparse-Merkle ROOT over keyed leaves
+#     (chain/smt.py + state_leaves) instead of sha256 of the flat
+#     encoding.  The blob payload layout is UNCHANGED (the migration is
+#     the identity) but every state_hash a block commits to is
+#     re-rooted, so v7 is consensus-incompatible with v6 heads
+#     (SYNC_PROTO_VERSION bumped alongside).
 #
 # MIGRATIONS[v] upgrades a decoded v payload dict to v+1; restore runs
 # the chain v → FORMAT_VERSION, so any supported older blob loads into
@@ -342,7 +475,7 @@ def _dataclass_registry() -> dict[str, type]:
 # entry here instead of breaking old fixtures.
 
 MAGIC = b"CESSCKPT"
-FORMAT_VERSION = 6
+FORMAT_VERSION = 7
 
 
 def _migrate_v1_to_v2(data: dict) -> dict:
@@ -412,9 +545,17 @@ def _migrate_v5_to_v6(data: dict) -> dict:
     return data
 
 
+def _migrate_v6_to_v7(data: dict) -> dict:
+    """v7 re-rooted the state hash (sparse-Merkle root over keyed
+    leaves) without touching the payload layout: the migration is the
+    identity on the decoded dict, and the receiving node derives the
+    new root from the restored state."""
+    return data
+
+
 MIGRATIONS = {1: _migrate_v1_to_v2, 2: _migrate_v2_to_v3,
               3: _migrate_v3_to_v4, 4: _migrate_v4_to_v5,
-              5: _migrate_v5_to_v6}
+              5: _migrate_v5_to_v6, 6: _migrate_v6_to_v7}
 
 
 # ---------------------------------------------------------------- API
@@ -427,9 +568,11 @@ def state_encode(rt) -> bytes:
 
 
 def state_hash(rt) -> str:
-    """Deterministic hex digest of the full chain state (the payload
-    only — the replay-determinism anchor is header-independent)."""
-    return hashlib.sha256(state_encode(rt)).hexdigest()
+    """Deterministic hex digest of the full chain state: the sparse-
+    Merkle root over the keyed leaves (header-independent, and the
+    FULL-REBUILD bit-identity oracle for the incremental StateDB root
+    in chain/state.py)."""
+    return _leaves_root_hex(state_leaves(rt))
 
 
 def encode_events(events: list) -> bytes:
@@ -457,21 +600,27 @@ def snapshot(rt) -> bytes:
 
 
 def snapshot_and_hash(rt) -> tuple[bytes, str]:
-    """One encoding pass for callers that need both the blob and the
-    state hash (the node service snapshots every committed block)."""
-    payload = state_encode(rt)
+    """One extraction pass for callers that need both the blob and the
+    state hash (genesis, checkpoint cadence, export-state): the hash is
+    the sparse-Merkle root over the same extracted surfaces the blob
+    encodes."""
+    extract = _extract(rt)
+    out: list[bytes] = []
+    _canon(extract, out)
+    payload = b"".join(out)
     header = MAGIC + FORMAT_VERSION.to_bytes(2, "big")
-    return header + payload, hashlib.sha256(payload).hexdigest()
+    return header + payload, _leaves_root_hex(state_leaves(extract=extract))
 
 
 def blob_payload_hash(blob: bytes) -> str:
-    """sha256 of a CURRENT-version blob's canonical payload WITHOUT
-    decoding it — the cheap integrity gate the on-disk store
-    (node/store.py) runs before restoring a checkpoint: the value must
-    equal the state_hash the signed head block commits to, so a torn
-    or bit-flipped checkpoint file fails closed before any restore
-    work.  Only meaningful for FORMAT_VERSION blobs (older versions
-    hash differently after migration); anything else raises."""
+    """State hash a CURRENT-version blob's payload commits to — the
+    integrity gate the on-disk store (node/store.py) runs before
+    restoring a checkpoint: the value must equal the state_hash the
+    signed head block commits to, so a torn or bit-flipped checkpoint
+    file fails closed before any restore work.  Since v7 this decodes
+    the payload and roots its keyed leaves (checkpoint-cadence cost,
+    never per block).  Only meaningful for FORMAT_VERSION blobs (older
+    versions hash differently after migration); anything else raises."""
     if not blob.startswith(MAGIC):
         raise ValueError("headerless blob has no comparable payload hash")
     version = int.from_bytes(blob[len(MAGIC):len(MAGIC) + 2], "big")
@@ -480,7 +629,14 @@ def blob_payload_hash(blob: bytes) -> str:
             f"payload hash is version-bound (blob v{version}, "
             f"build v{FORMAT_VERSION})"
         )
-    return hashlib.sha256(blob[len(MAGIC) + 2:]).hexdigest()
+    payload = blob[len(MAGIC) + 2:]
+    reader = _Reader(payload, _dataclass_registry())
+    data = reader.read()
+    if reader.off != len(payload):
+        raise ValueError("trailing bytes in snapshot")
+    if not isinstance(data, dict):
+        raise ValueError("snapshot payload is not a state mapping")
+    return _leaves_root_hex(state_leaves(extract=data))
 
 
 def decode_blob(blob: bytes) -> tuple[int, dict]:

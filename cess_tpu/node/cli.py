@@ -13,6 +13,8 @@ purge-chain) mapped onto this framework's service:
   trace         render a stitched span trace (block #N or trace id),
                 merging spans from several nodes (node/tracing.py)
   events        fetch one block's deposited events (chain_getEvents)
+  proof         fetch + verify a Merkle state read proof (stateless:
+                the only thing trusted is the root hash)
   bench         run the repo bench (north-star measurement)
 """
 
@@ -258,6 +260,40 @@ def _cmd_events(args) -> int:
     return 0
 
 
+def _cmd_proof(args) -> int:
+    """Stateless read verification: fetch a proof over RPC and check it
+    against a state root with chain/checkpoint.py verify_read — no
+    local chain state.  The root comes from --root (e.g. a justified
+    header obtained out of band) or, for a connectivity smoke test
+    only, from the node itself (state_getRoot) — the latter trusts the
+    node, the former does not."""
+    from ..chain.checkpoint import verify_read
+    from ..chain.smt import ProofError
+    from .rpc import rpc_call
+
+    key = json.loads(args.key) if args.key is not None else None
+    got = rpc_call(args.host, args.port, "state_getProof",
+                   [args.pallet, args.attr, key])
+    root = args.root if args.root else rpc_call(
+        args.host, args.port, "state_getRoot")
+    try:
+        present, value = verify_read(
+            root, args.pallet, args.attr, got["proof"], key=key)
+    except ProofError as e:
+        print(f"INVALID: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps({
+        "root": root,
+        "rootSource": "argument" if args.root else "node (UNVERIFIED)",
+        "pallet": args.pallet,
+        "attr": args.attr,
+        "key": key,
+        "present": present,
+        "value": repr(value) if present else None,
+    }, indent=2, sort_keys=True))
+    return 0
+
+
 def _cmd_bench(_args) -> int:
     import runpy
 
@@ -365,6 +401,24 @@ def build_parser() -> argparse.ArgumentParser:
     ev.add_argument("--port", type=int, default=9944)
     ev.add_argument("block", help="block number or hash")
     ev.set_defaults(fn=_cmd_events)
+
+    pr = sub.add_parser(
+        "proof", help="fetch + statelessly verify a state read proof")
+    pr.add_argument("--host", default="127.0.0.1")
+    pr.add_argument("--port", type=int, default=9944)
+    pr.add_argument("--root", default=None,
+                    help="hex state root to verify against (e.g. the "
+                         "state_hash of a finalized header); omitted, "
+                         "the node's own head root is used — which "
+                         "trusts the node and only smoke-tests the "
+                         "proof plumbing")
+    pr.add_argument("pallet", help='pallet name, e.g. "state"')
+    pr.add_argument("attr",
+                    help='attribute path, e.g. "balances.accounts"')
+    pr.add_argument("key", nargs="?", default=None,
+                    help="JSON-encoded map key (keyed surfaces only), "
+                         'e.g. \'"alice"\'')
+    pr.set_defaults(fn=_cmd_proof)
 
     be = sub.add_parser("bench", help="run the north-star bench")
     be.set_defaults(fn=_cmd_bench)

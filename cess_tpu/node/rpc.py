@@ -187,6 +187,28 @@ class RpcApi:
         def _shash():
             return s.state_hash()
 
+        @method("state_getRoot")
+        def _sroot():
+            """Head state-trie root (same value as state_getStateHash —
+            the state hash IS the keyed sparse-Merkle root since
+            checkpoint v7; kept as its own method so proof clients name
+            the commitment they verify against)."""
+            with s._lock:
+                return s.statedb.root_hex()
+
+        @method("state_getProof")
+        def _sproof(pallet: str, attr: str, key=None):
+            """Merkle read proof for one state entry against the head
+            root (chain/smt.py wire form).  `key` is required for keyed
+            maps (balances.accounts, nonces, deal_map, file) and must
+            be omitted for whole-attribute leaves.  Verify standalone
+            with chain/checkpoint.py verify_read — no local state."""
+            with s._lock:
+                try:
+                    return s.statedb.prove(pallet, attr, key=key)
+                except (ValueError, AttributeError) as e:
+                    raise RpcError(-32602, str(e))
+
         @method("state_getEvents")
         def _events(last: int = 20):
             return _view(list(s.rt.state.events)[-int(last):])

@@ -32,6 +32,7 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
 from ..chain.runtime import Runtime
+from ..chain.state import StateDB
 from ..chain.types import DispatchError
 from ..chain import checkpoint
 from ..chain import fees as fees_mod
@@ -603,8 +604,10 @@ class BlockRecord:
     batch_verified: bool = False
 
 
-# Recent post-state snapshots kept for head-reorg rollback and
-# state-mismatch recovery (the reference keeps the full chain DB; this
+# Recent per-block state DELTAS kept for head-reorg rollback and
+# state-mismatch recovery: leaf-level old+new encodings (chain/state.py
+# StateDB), so rewinding k blocks reverts k deltas instead of restoring
+# a full post-state blob (the reference keeps the full chain DB; this
 # bounds memory on long-running nodes).  Exposed as a NodeService class
 # attribute so sync.py derives its fork-rewind window from it instead
 # of duplicating the number.
@@ -713,13 +716,17 @@ class NodeService:
             self.authority_sk = dev_sk(self._ocw_identity, spec.chain_id)
 
         # Block store + head anchor (the chain-DB role): parent of block
-        # #1 is the genesis spec hash; recent post-state blobs allow
-        # head-reorg rollback and failed-import recovery.
+        # #1 is the genesis spec hash.  The state commitment is kept
+        # INCREMENTALLY (chain/state.py StateDB — the sparse-Merkle
+        # tree over keyed leaves), and recent per-block leaf deltas
+        # replace the old full post-state blob cache: reverting a delta
+        # rolls the head back bit-exactly, reapplying reinstates it.
         self.head_hash = self.genesis  # guarded-by: _lock
         self.block_store: dict[str, Block] = {}  # guarded-by: _lock
         self.block_by_number: dict[int, Block] = {}  # guarded-by: _lock
-        self._state_blobs: OrderedDict[str, bytes] = OrderedDict()  # guarded-by: _lock
-        self._state_blobs[self.genesis] = checkpoint.snapshot(self.rt)
+        self.statedb = StateDB(self.rt)  # guarded-by: _lock
+        self._state_deltas: OrderedDict[str, list] = OrderedDict()  # guarded-by: _lock
+        self._state_deltas[self.genesis] = []
 
         # Observability (node/tracing.py + the per-block event ring):
         # the tracer collects span trees; block_traces maps block hash →
@@ -845,6 +852,25 @@ class NodeService:
                 ("sig_batch", "signature batch verification"),
                 ("execute", "deterministic re-execution"),
                 ("snapshot", "post-state snapshot + hash"),
+            )
+        }
+        # State-trie observability: dirty-leaf count per committed
+        # block, and the root-computation cost split by path — the
+        # incremental touched-path rehash every block pays vs the
+        # full-rebuild oracle (checkpoint cadence / restore rebase).
+        self.m_state_dirty = m.Histogram(
+            "cess_state_dirty_keys",
+            "state-trie leaves touched per committed block",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 256, 1024, 4096),
+            registry=reg)
+        self.m_state_hash = {
+            mode: m.Histogram(
+                f"cess_state_hash_{mode}_seconds",
+                f"state root {label}",
+                buckets=stage_buckets, registry=reg)
+            for mode, label in (
+                ("incremental", "incremental (touched-path) rehash time"),
+                ("full", "full-rebuild oracle time"),
             )
         }
         # Import-pipeline observability: queue depth is the gossip
@@ -1120,22 +1146,22 @@ class NodeService:
         return None
 
     def _commit_block(  # holds-lock: _lock
-        self, block: Block, record: BlockRecord, blob: bytes,
+        self, block: Block, record: BlockRecord, delta: list,
         events: list | None = None, trace: str | None = None,
     ) -> None:
-        """Head bookkeeping after a block executed: store, cache the
-        post-state blob, advance the head anchor and slot clock, file
-        the block's deposited events into the per-block ring and pin
-        its trace id."""
+        """Head bookkeeping after a block executed: store, buffer the
+        block's state delta for reorg rollback, advance the head anchor
+        and slot clock, file the block's deposited events into the
+        per-block ring and pin its trace id."""
         h = block.hash(self.genesis)
         record.hash = h
         self.block_store[h] = block
         self.block_by_number[block.number] = block
         self.head_hash = h
         self.slot = max(self.slot, block.slot)
-        self._state_blobs[h] = blob
-        while len(self._state_blobs) > STATE_CACHE_BLOCKS:
-            self._state_blobs.popitem(last=False)
+        self._state_deltas[h] = delta
+        while len(self._state_deltas) > STATE_CACHE_BLOCKS:
+            self._state_deltas.popitem(last=False)
         if events is not None:
             self.events_by_block[h] = (block.number, list(events))
             self.m_events.inc(len(events))
@@ -1164,11 +1190,31 @@ class NodeService:
                 checkpoint.events_digest(events)
                 if events is not None else "",
                 self.justifications.get(block.number),
+                delta=delta,
             )
+            # the blob thunk keeps per-block checkpoint cost O(touched):
+            # the store only materializes the full snapshot (and runs
+            # the oracle check inside it) on its checkpoint cadence
             self.store.maybe_checkpoint(
-                block, blob, self.justifications.get(block.number))
+                block, self._checkpoint_blob,
+                self.justifications.get(block.number))
         self.m_pool.set(len(self.pool))
         self.m_finality_lag.set(block.number - self.finalized_number)
+
+    def _checkpoint_blob(self) -> bytes:  # holds-lock: _lock
+        """Full checkpoint blob, built only on the store's cadence.
+        Doubles as the standing ORACLE point: the full-rebuild root must
+        equal the root the committed head block carries, so a missed
+        dirty key in the incremental tracking fails loudly within one
+        checkpoint interval instead of silently forking replicas."""
+        with self.m_state_hash["full"].time():
+            blob, shash = checkpoint.snapshot_and_hash(self.rt)
+        head = self.block_store.get(self.head_hash)
+        if head is not None and head.state_hash != shash:
+            raise RuntimeError(
+                f"state-trie divergence at #{head.number}: full-rebuild "
+                f"oracle {shash} != committed root {head.state_hash}")
+        return blob
 
     def produce_block(self, slot: int | None = None) -> BlockRecord | None:
         """One slot: on_initialize hooks, then apply pooled extrinsics.
@@ -1251,8 +1297,10 @@ class NodeService:
                     # the snapshot), so the state hash commits to it —
                     # importers run the identical distribute
                     self.rt.fees.distribute(author)
-                with self.tracer.span("author.snapshot"):
-                    blob, shash = checkpoint.snapshot_and_hash(self.rt)
+                with self.tracer.span("author.snapshot"), \
+                        self.m_state_hash["incremental"].time():
+                    shash, delta = self.statedb.commit()
+                self.m_state_dirty.observe(len(delta))
                 events = self.rt.state.events_since(ev_base)
                 block = Block(
                     number=record.number, slot=slot, parent=parent,
@@ -1263,7 +1311,7 @@ class NodeService:
                 )
                 block.sign(sk, self.genesis)
                 root.tags["number"] = record.number
-                self._commit_block(block, record, blob,
+                self._commit_block(block, record, delta,
                                    events=events, trace=tid)
                 self.m_blocks.inc()
                 (self.m_vrf_primary if claim.primary
@@ -1319,32 +1367,32 @@ class NodeService:
 
     def _rollback_head(  # holds-lock: _lock
         self,
-    ) -> tuple[Block, str, bytes, BlockRecord | None, list | None]:
-        """Drop the current head (same-height fork choice lost): restore
-        the parent post-state blob and rewind bookkeeping.  Pool nonces
-        are left at their high-water mark — intake gating is node-local,
-        never consensus state.  Returns everything needed to reinstate
-        the head if the replacement block then fails verification (the
-        fork choice must be transactional: an unverified announce must
-        never leave the node headless).  Checks the parent blob BEFORE
-        mutating anything, so failure leaves state untouched."""
+    ) -> tuple[Block, str, list, BlockRecord | None, list | None]:
+        """Drop the current head (same-height fork choice lost): revert
+        its state delta and rewind bookkeeping.  Pool nonces are left at
+        their high-water mark — intake gating is node-local, never
+        consensus state.  Returns everything needed to reinstate the
+        head if the replacement block then fails verification (the fork
+        choice must be transactional: an unverified announce must never
+        leave the node headless).  Checks the delta BEFORE mutating
+        anything, so failure leaves state untouched."""
         head = self.block_store[self.head_hash]
-        parent_blob = self._state_blobs.get(head.parent)
-        if parent_blob is None:
-            raise BlockImportError("parent state evicted; cannot reorg")
+        head_delta = self._state_deltas.get(self.head_hash)
+        if head_delta is None:
+            raise BlockImportError("head state delta evicted; cannot reorg")
         head_hash = self.head_hash
-        head_blob = self._state_blobs.pop(head_hash)
+        self._state_deltas.pop(head_hash)
         self.block_store.pop(head_hash)
         self.block_by_number.pop(head.number, None)
         record = None
         if self.blocks and self.blocks[-1].number == head.number:
             record = self.blocks.pop()
         # retract the head's events: drop its ring entry and (when the
-        # sink tail still ends with exactly those events — checkpoint
-        # restore no longer rewinds the sink) truncate the sink, so a
-        # replica that never saw the losing block reads the same ring
+        # sink tail still ends with exactly those events — delta revert
+        # never touches the sink) truncate the sink, so a replica that
+        # never saw the losing block reads the same ring
         head_events = self._retract_events(head_hash)
-        checkpoint.restore(self.rt, parent_blob)
+        self.statedb.revert(head_delta)
         self.head_hash = head.parent
         # NOTE: _voted deliberately keeps the retracted height.  A vote
         # for the dead hash may already be part of a forming quorum;
@@ -1354,7 +1402,7 @@ class NodeService:
         # possibly-lapsed boundary; the next period finalizes normally.
         self._requeue_retracted([head])
         self.m_reorgs.inc()
-        return head, head_hash, head_blob, record, head_events
+        return head, head_hash, head_delta, record, head_events
 
     def _retract_events(self, block_hash: str) -> list | None:  # holds-lock: _lock
         """Drop a retracted block's ring entry and rewind the runtime
@@ -1371,16 +1419,17 @@ class NodeService:
         return events
 
     def _reinstate_head(  # holds-lock: _lock
-        self, head: Block, head_hash: str, head_blob: bytes,
+        self, head: Block, head_hash: str, head_delta: list,
         record: BlockRecord | None, head_events: list | None,
     ) -> None:
         """Undo a _rollback_head after the competing block failed
-        verification: restore the old head's state and bookkeeping and
+        verification: reapply the old head's state delta (the runtime
+        is back at the parent state) and restore its bookkeeping, and
         take its extrinsics back out of the pool."""
-        checkpoint.restore(self.rt, head_blob)
+        self.statedb.apply(head_delta)
         self.block_store[head_hash] = head
         self.block_by_number[head.number] = head
-        self._state_blobs[head_hash] = head_blob
+        self._state_deltas[head_hash] = head_delta
         self.head_hash = head_hash
         if head_events is not None:
             self.events_by_block[head_hash] = (head.number, head_events)
@@ -1393,6 +1442,7 @@ class NodeService:
         self, block: Block, sigs_verified: bool = False,
         trace: str | None = None, origin: str = "announce",
         batch_vrf_msg: bytes | None = None,
+        journal_delta: list | None = None,
     ) -> BlockRecord | None:
         """Verify and re-execute a peer block (the import-queue role).
 
@@ -1444,7 +1494,8 @@ class NodeService:
         ) as root:
             try:
                 rec = self._import_block_inner(
-                    block, sigs_verified, batch_vrf_msg=batch_vrf_msg)
+                    block, sigs_verified, batch_vrf_msg=batch_vrf_msg,
+                    journal_delta=journal_delta)
             except BlockImportError as e:
                 root.tags["rejected"] = str(e)
                 self.m_import_rejected.inc()
@@ -1495,6 +1546,7 @@ class NodeService:
     def _import_block_inner(
         self, block: Block, sigs_verified: bool = False,
         batch_vrf_msg: bytes | None = None,
+        journal_delta: list | None = None,
     ) -> BlockRecord | None:
         with self._lock:
             try:
@@ -1569,7 +1621,8 @@ class NodeService:
                 record = self._verify_and_apply(
                     block, author_verified=author_verified,
                     sigs_verified=sigs_verified,
-                    batch_vrf_msg=batch_vrf_msg)
+                    batch_vrf_msg=batch_vrf_msg,
+                    journal_delta=journal_delta)
             except BlockImportError:
                 if undo is not None:
                     self._reinstate_head(*undo)
@@ -1607,15 +1660,22 @@ class NodeService:
         self, block: Block, author_verified: bool = False,
         sigs_verified: bool = False,
         batch_vrf_msg: bytes | None = None,
-    ) -> tuple[BlockRecord, bytes, list]:
+        journal_delta: list | None = None,
+    ) -> tuple[BlockRecord, list, list]:
         """Slot-claim check + signature batch + deterministic
-        re-execution; rolls the runtime back on a post-state mismatch.
+        re-execution; reverts the state delta on a post-state mismatch.
         Caller holds the lock, runtime is at the parent state.
         `author_verified=True` (the fork-choice path, where
         _check_author_signature already ran a full pairing) keeps the
         block signature out of the batch instead of paying for it
         twice; `sigs_verified=True` (range-batch catch-up) skips every
-        pairing — the structural checks and re-execution still run."""
+        pairing — the structural checks and re-execution still run.
+        `journal_delta` (crash recovery) is a state delta this node
+        itself journalled for the block: after the signature checks it
+        is applied directly and, when the resulting root matches the
+        header, re-execution is skipped entirely — the root check makes
+        a tampered journal indistinguishable from a bad block.  Events
+        are not replayed on that path (telemetry-only loss)."""
         pk = self._author_pk(block)
         # VRF slot claim: structural rules against the parent state
         # (output↔proof binding, threshold/secondary schedule); the
@@ -1693,7 +1753,32 @@ class NodeService:
             if not ok:
                 raise BlockImportError("bad block/extrinsic/vrf signature")
 
-        pre_blob = self._state_blobs.get(self.head_hash)
+        if journal_delta is not None:
+            # Journal fast-forward: the delta came from OUR OWN journal
+            # (already signature-checked above), so replaying it and
+            # checking the root against the signed header is as strong
+            # as re-execution — the root commits to every leaf.
+            try:
+                root = self.statedb.apply(journal_delta)
+            except (KeyError, TypeError, ValueError, AttributeError):
+                root = None
+            if root == block.state_hash:
+                record = BlockRecord(
+                    number=block.number, author=block.author,
+                    imported=True)
+                # per-extrinsic receipts are not replayed (telemetry
+                # loss, like events); the hashes are deterministic
+                record.extrinsics = [
+                    ext.hash(self.genesis) for ext in exts]
+                for ext in exts:
+                    cur = self.nonces.get(ext.signer, 0)
+                    self.nonces[ext.signer] = max(cur, ext.nonce + 1)
+                self.pool.prune(set(record.extrinsics), self.rt.state.nonces)
+                self._update_pool_metrics()
+                return record, journal_delta, []
+            if root is not None:
+                self.statedb.revert(journal_delta)
+            # fall through to deterministic re-execution
         ev_base = self.rt.state.event_mark()
         # the verified output becomes consensus state before the block
         # executes — mirror of produce_block's fold order
@@ -1710,14 +1795,15 @@ class NodeService:
             # identical fee split to produce_block, pre-snapshot
             self.rt.fees.distribute(block.author)
         with self.tracer.span("import.snapshot"), \
-                self.m_import_stage["snapshot"].time():
-            blob, shash = checkpoint.snapshot_and_hash(self.rt)
+                self.m_import_stage["snapshot"].time(), \
+                self.m_state_hash["incremental"].time():
+            shash, delta = self.statedb.commit()
+        self.m_state_dirty.observe(len(delta))
         if shash != block.state_hash:
-            # rewind the event sink too: checkpoint blobs no longer
-            # carry events, so the restore below cannot do it
+            # rewind the event sink too: the delta tracks keyed state
+            # only, so the revert below cannot do it
             del self.rt.state.events[ev_base:]
-            if pre_blob is not None:
-                checkpoint.restore(self.rt, pre_blob)
+            self.statedb.revert(delta)
             raise BlockImportError("post-state hash mismatch")
         events = self.rt.state.events_since(ev_base)
         # advance intake nonces so local submissions stay in step,
@@ -1727,7 +1813,7 @@ class NodeService:
             self.nonces[ext.signer] = max(cur, ext.nonce + 1)
         self.pool.prune(set(record.extrinsics), self.rt.state.nonces)
         self._update_pool_metrics()
-        return record, blob, events
+        return record, delta, events
 
     def handle_announce(self, block_json: dict,
                         trace: str | None = None) -> str:
@@ -1885,7 +1971,7 @@ class NodeService:
 
     def import_batch(
         self, blocks: list[Block], traces: list | None = None,
-        origin: str = "batch",
+        origin: str = "batch", deltas: list | None = None,
     ) -> list[tuple[str, object]]:
         """Import consecutive peer blocks with their pairings folded
         into weighted batches (the pipelined import path shared by
@@ -1939,7 +2025,8 @@ class NodeService:
                             blocks[j], sigs_verified=verified, trace=tr,
                             origin=origin,
                             batch_vrf_msg=(staged["msgs"][j - i]
-                                           if verified else None))
+                                           if verified else None),
+                            journal_delta=(deltas[j] if deltas else None))
                     except SyncGap:
                         outcomes.append(("gap", None))
                     except BlockImportError as e:
@@ -2033,9 +2120,11 @@ class NodeService:
 
     def reorg_to(self, ancestor_number: int) -> bool:
         """Rewind the chain to `ancestor_number` (longest-chain fork
-        resolution): restore its cached post-state blob and drop all
-        bookkeeping above it.  Refuses to cross finality or leave the
-        state-blob window."""
+        resolution): revert each retracted block's state delta newest
+        first and drop all bookkeeping above it.  Refuses to cross
+        finality or leave the delta window — checked for EVERY block in
+        the retraction range BEFORE mutating anything, so a refusal
+        leaves state untouched."""
         with self._lock:
             head_n = self.rt.state.block_number
             if ancestor_number < self.finalized_number:
@@ -2049,21 +2138,28 @@ class NodeService:
                 if blk is None:
                     return False
                 anchor = blk.hash(self.genesis)
-            blob = self._state_blobs.get(anchor)
-            if blob is None:
-                return False
-            checkpoint.restore(self.rt, blob)
-            retracted = []
+            # transactional pre-check: every retracted block must have
+            # a journalled delta, or the unwind would strand mid-chain
+            chain: list[tuple[Block, str, list]] = []
             for n in range(head_n, ancestor_number, -1):
+                blk = self.block_by_number.get(n)
+                if blk is None:
+                    return False
+                bh = blk.hash(self.genesis)
+                delta = self._state_deltas.get(bh)
+                if delta is None:
+                    return False
+                chain.append((blk, bh, delta))
+            retracted = []
+            for blk, bh, delta in chain:
                 # newest first, so the event-sink tail rewinds block by
                 # block (each retraction strips its own events tail)
-                blk = self.block_by_number.pop(n, None)
-                if blk is not None:
-                    retracted.append(blk)
-                    bh = blk.hash(self.genesis)
-                    self.block_store.pop(bh, None)
-                    self._state_blobs.pop(bh, None)
-                    self._retract_events(bh)
+                self.statedb.revert(delta)
+                self.block_by_number.pop(blk.number, None)
+                retracted.append(blk)
+                self.block_store.pop(bh, None)
+                self._state_deltas.pop(bh, None)
+                self._retract_events(bh)
             while self.blocks and self.blocks[-1].number > ancestor_number:
                 self.blocks.pop()
             self.head_hash = anchor
@@ -2574,7 +2670,7 @@ class NodeService:
         self.block_store.clear()
         self.block_by_number.clear()
         self.blocks.clear()
-        self._state_blobs.clear()
+        self._state_deltas.clear()
         # pre-restore history is gone: the event ring and the runtime
         # sink restart with the restored chain (events are per-block
         # telemetry, never part of a checkpoint blob)
@@ -2585,7 +2681,12 @@ class NodeService:
             self.block_store[anchor_hash] = head
             self.block_by_number[head.number] = head
             self.slot = max(self.slot, head.slot)
-        self._state_blobs[anchor_hash] = checkpoint.snapshot(self.rt)
+        # the restore replaced pallet containers wholesale (destroying
+        # the write-through wrappers) — rebase the state trie on the
+        # restored runtime and restart the delta window from the anchor
+        with self.m_state_hash["full"].time():
+            self.statedb.rebase()
+        self._state_deltas[anchor_hash] = []
         # Rebase the pool onto the restored consensus nonces: spent
         # slots drop, survivors keep their fee-priced priority.  The
         # rejection cache survives on purpose — a fee-rejected payload
@@ -2659,6 +2760,9 @@ class NodeService:
                 ok = False
             if not ok:
                 checkpoint.restore(self.rt, undo)
+                # restore replaced the pallet containers — re-attach
+                # the state trie's write-through tracking
+                self.statedb.rebase()
                 return False
             self._reset_chain_index(bh, head)
             # the anchor arrived finalized — start from there
@@ -2708,6 +2812,9 @@ class NodeService:
                 ok = False
             if not ok:
                 checkpoint.restore(self.rt, undo)
+                # restore replaced the pallet containers — re-attach
+                # the state trie's write-through tracking
+                self.statedb.rebase()
                 return False
             self._reset_chain_index(bh, head)
             if (
@@ -2725,8 +2832,11 @@ class NodeService:
         return True
 
     def state_hash(self) -> str:
+        """Head state root — O(1): the incrementally maintained trie
+        root, not a full re-encode (checkpoint.state_hash stays as the
+        bit-identity oracle, checked at every on-disk checkpoint)."""
         with self._lock:
-            return checkpoint.state_hash(self.rt)
+            return self.statedb.root_hex()
 
     def events_of_block(self, block_ref) -> tuple | None:
         """Per-block deposited events (`chain_getEvents` feed): accepts

@@ -8,8 +8,11 @@ framework's in-memory runtime, under `--data-dir` (node/cli.py):
  * **Write-ahead block journal** (`journal/seg-%08d.wal`): one
    length-prefixed, blake2b-checksummed record per committed block —
    header + extrinsics (the full signed Block wire form), the block's
-   deposited-events digest, and any justification known at commit —
-   fsync'd BEFORE the block is acknowledged to the network
+   deposited-events digest, its keyed state delta (chain/state.py —
+   replay applies the delta and checks the resulting trie root against
+   the signed header, skipping re-execution when it matches), and any
+   justification known at commit — fsync'd BEFORE the block is
+   acknowledged to the network
    (NodeService._commit_block runs the append under the service lock,
    ahead of the gossip announce).  Finality advancing later appends a
    justification record, so replay recovers the finalized head too.
@@ -314,12 +317,17 @@ class BlockStore:
             return True
 
     def journal_block(self, block: Block, events_digest: str,
-                      justification: "Justification | None" = None
+                      justification: "Justification | None" = None,
+                      delta: "list | None" = None,
                       ) -> bool:
+        from ..chain.state import encode_delta
+
         body = canonical_json({
             "t": "block",
             "block": block.to_json(),
             "eventsDigest": events_digest,
+            "delta": (encode_delta(delta)
+                      if delta is not None else None),
             "just": (justification.to_json()
                      if justification is not None else None),
         })
@@ -414,12 +422,17 @@ class BlockStore:
             return True
 
     def maybe_checkpoint(
-        self, block: Block, blob: bytes,
+        self, block: Block, blob,
         justification: "Justification | None" = None,
     ) -> None:
         """Checkpoint cadence: every `checkpoint_every` blocks the
-        commit path hands its (already computed) post-state blob here."""
+        commit path hands its post-state blob here — either the bytes,
+        or a zero-arg callable producing them (the service passes a
+        thunk so the full state re-encode is only paid ON the cadence,
+        not per block — per-block hashing is incremental now)."""
         if block.number - self._ckpt_number >= self.checkpoint_every:
+            if callable(blob):
+                blob = blob()
             self.write_checkpoint(blob, block, justification)
 
     def _prune_segments(self) -> None:
@@ -520,15 +533,16 @@ class BlockStore:
         replayed = 0
         truncated = 0
         deduped = 0
-        batch: list[tuple[Block, int]] = []
+        batch: list[tuple[Block, int, "list | None"]] = []
 
         def flush() -> None:
             nonlocal replayed
             if not batch:
                 return
             outcomes = service.import_batch(
-                [b for b, _ in batch], origin="journal")
-            for (blk, seq), (kind, _) in zip(batch, outcomes):
+                [b for b, _, _ in batch], origin="journal",
+                deltas=[d for _, _, d in batch])
+            for (blk, seq, _), (kind, _) in zip(batch, outcomes):
                 if kind in ("rejected", "gap"):
                     # verification rejected it (tampered record, or a
                     # fork branch orphaned by a reorg whose winner
@@ -556,15 +570,16 @@ class BlockStore:
             for body in bodies:
                 kind, payload = self._parse_record(body)
                 if kind == "block":
-                    if payload.number <= service.head_number():
+                    blk, delta = payload
+                    if blk.number <= service.head_number():
                         # covered by the restored checkpoint (or an
                         # earlier batch): never reaches import
                         self.m_replay_dedup.inc()
                         deduped += 1
                         self._seg_max[seq] = max(
-                            self._seg_max.get(seq, 0), payload.number)
+                            self._seg_max.get(seq, 0), blk.number)
                         continue
-                    batch.append((payload, seq))
+                    batch.append((blk, seq, delta))
                 elif kind == "just":
                     flush()
                     try:
@@ -590,9 +605,12 @@ class BlockStore:
         return replayed, truncated, deduped
 
     def _parse_record(self, body: bytes):
-        """One journal record body → ("block", Block) | ("just",
-        Justification) | (None, None); malformed records count as
-        skipped."""
+        """One journal record body → ("block", (Block, delta | None)) |
+        ("just", Justification) | (None, None); malformed records count
+        as skipped.  A malformed DELTA degrades to None (the block
+        re-executes instead of fast-forwarding) rather than skipping
+        the whole record — the delta is an optimization, the signed
+        block is the contract."""
         try:
             rec = json.loads(body)
             kind = rec.get("t")
@@ -609,10 +627,18 @@ class BlockStore:
             self.m_replay_skipped.inc()
             return None, None
         try:
-            return "block", Block.from_json(rec["block"])
+            block = Block.from_json(rec["block"])
         except (KeyError, TypeError, ValueError):
             self.m_replay_skipped.inc()
             return None, None
+        delta = None
+        if rec.get("delta") is not None:
+            from ..chain.state import decode_delta
+            try:
+                delta = decode_delta(rec["delta"])
+            except (KeyError, TypeError, ValueError):
+                delta = None
+        return "block", (block, delta)
 
     def recover(self, service) -> dict:
         """The startup recovery ladder.  Runs BEFORE the sync loop

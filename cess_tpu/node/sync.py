@@ -74,7 +74,11 @@ def _rpc_errors() -> tuple[type, ...]:
 # a tip field in their signing payload, fee charging and the 20/80
 # split are consensus state (checkpoint v6), so a v4 peer computes
 # different extrinsic hashes and state hashes for identical chains.
-SYNC_PROTO_VERSION = 5
+# v6: the state hash is the keyed sparse-Merkle trie root (chain/smt.py,
+# checkpoint v7) instead of a hash of the whole canonical blob — a v5
+# peer computes a different state_hash for identical state, so every
+# header it serves fails our post-state check.
+SYNC_PROTO_VERSION = 6
 
 # Peer-gossip socket timeout: announcements are fire-and-forget, a dead
 # peer must not stall the authoring loop.

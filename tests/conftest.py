@@ -109,6 +109,16 @@ def pytest_configure(config):
         "over the real tree) — CI runs these as their own fast gate, "
         "excluded from the main test run",
     )
+    config.addinivalue_line(
+        "markers",
+        "state_trie: keyed state-trie suite (tests/test_state_trie.py "
+        "— sparse-Merkle unit tests, adversarial proof refusal, "
+        "incremental-root vs full-rebuild bit-identity through "
+        "runtime ops, v6→v7 migration, delta revert/apply, 3-node "
+        "lockstep roots + stateless end-to-end read proof) — CI runs "
+        "these as their own fast gate, excluded from the main test "
+        "run",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -180,12 +180,25 @@ class TestVersionedFormat:
             raise AssertionError("future-version blob must be rejected")
 
     def test_state_hash_is_header_independent(self):
-        """state_hash hashes the payload only: the replay-determinism
-        anchor does not change when the envelope format is bumped."""
-        import hashlib
-
+        """state_hash commits the payload only: the replay-determinism
+        anchor does not change when the envelope format is bumped.
+        Since v7 the anchor is the keyed trie root over the decoded
+        payload (docs/state.md), so header-independence is checked via
+        blob_payload_hash — which parses past the header — rather than
+        hashing raw payload bytes."""
         rt = small_runtime()
         blob, h = checkpoint.snapshot_and_hash(rt)
         assert h == checkpoint.state_hash(rt)
+        assert checkpoint.blob_payload_hash(blob) == h
+        # Re-envelope the same payload under a bumped version byte: the
+        # anchor must not move with the header.  blob_payload_hash is
+        # deliberately version-bound, so decode past the header by hand
+        # and root the same payload.
         header_len = len(checkpoint.MAGIC) + 2
-        assert hashlib.sha256(blob[header_len:]).hexdigest() == h
+        bumped = (checkpoint.MAGIC
+                  + (checkpoint.FORMAT_VERSION + 1).to_bytes(2, "big")
+                  + blob[header_len:])
+        version, data = checkpoint.decode_blob(bumped)
+        assert version == checkpoint.FORMAT_VERSION + 1
+        root = checkpoint._leaves_root_hex(checkpoint.state_leaves(extract=data))
+        assert root == h

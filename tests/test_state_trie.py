@@ -1,0 +1,459 @@
+"""Keyed state trie (chain/smt.py + chain/state.py StateDB +
+checkpoint v7): sparse-Merkle unit behavior, adversarial proof
+refusal, incremental-root vs full-rebuild bit-identity through real
+runtime ops, the non-mutating balances read path, v6→v7 blob
+migration, delta revert/apply, and the node-level story — replica-
+identical roots across a 3-node block range and a STATELESS account
+read verified end-to-end against a justified root.
+
+Protocol-level: host blake2b + codec and host BLS only — no device
+compiles.  Every test carries the `state_trie` marker (own CI gate,
+excluded from the main run)."""
+
+import os
+
+import pytest
+
+from cess_tpu.chain import checkpoint, smt
+from cess_tpu.chain.runtime import Runtime
+from cess_tpu.chain.state import (
+    AccountData,
+    DirtyDict,
+    StateDB,
+    decode_delta,
+    encode_delta,
+)
+from cess_tpu.node import NodeService, RpcServer, SyncManager, local_spec
+from cess_tpu.node.chain_spec import ChainSpec
+from cess_tpu.node.metrics import scoped_registry
+
+pytestmark = pytest.mark.state_trie
+
+
+def make_spec(**kw) -> ChainSpec:
+    spec = local_spec()
+    spec.block_time_ms = 50
+    spec.finality_period = 4
+    for k, v in kw.items():
+        setattr(spec, k, v)
+    return spec
+
+
+def make_node(spec, authority) -> NodeService:
+    return NodeService(spec, authority=authority,
+                       registry=scoped_registry())
+
+
+# ------------------------------------------------------------- smt unit
+
+
+class TestSparseMerkleTree:
+    def leaves(self, n: int) -> dict[bytes, bytes]:
+        return {
+            smt.key_path(b"t", b"k%d" % i): b"v%d" % i for i in range(n)
+        }
+
+    def test_empty_and_single(self):
+        t = smt.SparseMerkleTree()
+        assert t.root() == smt.EMPTY
+        p = smt.key_path(b"t", b"solo")
+        t.update({p: b"x"})
+        # floating leaf: a single-leaf tree hashes to the leaf itself
+        assert t.root() == smt.leaf_hash(p, b"x")
+
+    def test_root_independent_of_insertion_order(self):
+        leaves = self.leaves(64)
+        bulk = smt.SparseMerkleTree(leaves)
+        one_by_one = smt.SparseMerkleTree()
+        for p, v in sorted(leaves.items(), reverse=True):
+            one_by_one.update({p: v})
+        assert bulk.root() == one_by_one.root()
+
+    def test_incremental_update_matches_rebuild(self):
+        leaves = self.leaves(200)
+        t = smt.SparseMerkleTree(leaves)
+        t.root()  # populate the memo, then mutate through it
+        writes = {}
+        for i in range(0, 200, 17):
+            p = smt.key_path(b"t", b"k%d" % i)
+            writes[p] = b"updated-%d" % i
+            leaves[p] = writes[p]
+        # one delete and one insert ride the same batch
+        gone = smt.key_path(b"t", b"k3")
+        writes[gone] = None
+        del leaves[gone]
+        new = smt.key_path(b"t", b"fresh")
+        writes[new] = b"fresh"
+        leaves[new] = b"fresh"
+        assert t.update(writes) == smt.SparseMerkleTree(leaves).root()
+
+    def test_delete_to_empty(self):
+        leaves = self.leaves(5)
+        t = smt.SparseMerkleTree(leaves)
+        t.update({p: None for p in leaves})
+        assert t.root() == smt.EMPTY
+
+    def test_proofs_inclusion_and_non_inclusion(self):
+        leaves = self.leaves(50)
+        t = smt.SparseMerkleTree(leaves)
+        root = t.root()
+        hit = smt.key_path(b"t", b"k7")
+        present, value = smt.verify_proof(root, hit, t.prove(hit))
+        assert (present, value) == (True, b"v7")
+        miss = smt.key_path(b"t", b"nope")
+        present, value = smt.verify_proof(root, miss, t.prove(miss))
+        assert (present, value) == (False, None)
+
+    def test_proof_wire_roundtrip(self):
+        t = smt.SparseMerkleTree(self.leaves(9))
+        p = smt.key_path(b"t", b"k2")
+        proof = t.prove(p)
+        again = smt.Proof.from_wire(proof.to_wire())
+        assert again == proof
+        assert smt.verify_proof(t.root(), p, again)[0] is True
+
+
+class TestAdversarialProofs:
+    """Every forgery class refuses with ProofError — a tampered proof
+    must never verify and never return a wrong value silently."""
+
+    def setup_method(self):
+        self.t = smt.SparseMerkleTree({
+            smt.key_path(b"t", b"k%d" % i): b"v%d" % i for i in range(40)
+        })
+        self.root = self.t.root()
+        self.path = smt.key_path(b"t", b"k11")
+        self.proof = self.t.prove(self.path)
+
+    def refused(self, proof, path=None, root=None):
+        with pytest.raises(smt.ProofError):
+            smt.verify_proof(root or self.root, path or self.path, proof)
+
+    def test_tampered_sibling(self):
+        sibs = list(self.proof.siblings)
+        sibs[0] = bytes(32)
+        self.refused(smt.Proof(tuple(sibs), self.proof.leaf_path,
+                               self.proof.leaf_value))
+
+    def test_truncated_path(self):
+        self.refused(smt.Proof(self.proof.siblings[:-1],
+                               self.proof.leaf_path,
+                               self.proof.leaf_value))
+
+    def test_wrong_root(self):
+        self.refused(self.proof, root=smt._h(b"not-the-root"))
+
+    def test_value_substitution(self):
+        self.refused(smt.Proof(self.proof.siblings, self.proof.leaf_path,
+                               b"forged value"))
+
+    def test_forged_non_inclusion(self):
+        # claim a PRESENT key is absent by pointing the terminal at a
+        # different real leaf: its path lies outside the audited
+        # subtree, so the prefix check refuses before hashing
+        other = smt.key_path(b"t", b"k12")
+        self.refused(smt.Proof(self.proof.siblings, other,
+                               self.t.get(other)))
+
+    def test_empty_terminal_forgery(self):
+        self.refused(smt.Proof(self.proof.siblings, None, None))
+
+    def test_terminal_leaf_without_value(self):
+        self.refused(smt.Proof(self.proof.siblings, self.proof.leaf_path,
+                               None))
+
+    def test_overlong_proof(self):
+        self.refused(smt.Proof(tuple(bytes(32) for _ in range(257)),
+                               None, None))
+
+
+# --------------------------------------------------------- statedb core
+
+
+class TestStateDB:
+    def test_genesis_root_matches_oracle(self):
+        rt = Runtime()
+        db = StateDB(rt)
+        assert db.root_hex() == checkpoint.state_hash(rt)
+
+    def test_commit_matches_oracle_through_runtime_ops(self):
+        rt = Runtime()
+        db = StateDB(rt)
+        bal = rt.state.balances
+        bal.mint("alice", 10_000)
+        bal.mint("bob", 5_000)
+        root, delta = db.commit()
+        assert root == checkpoint.state_hash(rt)
+        rt.next_block()
+        bal.transfer("alice", "bob", 123)
+        rt.state.nonces["alice"] = 1
+        root, delta = db.commit()
+        assert root == checkpoint.state_hash(rt)
+        assert any(k == checkpoint.canon_bytes("alice")
+                   for _, _, k, _, _ in delta if k is not None)
+
+    def test_revert_apply_bit_exact(self):
+        rt = Runtime()
+        db = StateDB(rt)
+        rt.state.balances.mint("alice", 10_000)
+        base_root, base_delta = db.commit()
+        rt.next_block()
+        rt.state.balances.transfer("alice", "alice-2", 77)
+        rt.state.nonces["alice"] = 1
+        root, delta = db.commit()
+        assert db.revert(delta) == base_root
+        assert checkpoint.state_hash(rt) == base_root
+        assert rt.state.balances.free("alice") == 10_000
+        assert db.apply(delta) == root
+        assert checkpoint.state_hash(rt) == root
+
+    def test_delta_wire_roundtrip(self):
+        rt = Runtime()
+        db = StateDB(rt)
+        rt.state.balances.mint("carol", 42)
+        _, delta = db.commit()
+        assert decode_delta(encode_delta(delta)) == delta
+
+    def test_corrupt_delta_is_atomic(self):
+        """_shift decodes everything before mutating anything: a delta
+        whose LAST entry is garbage must leave the runtime, the trie,
+        and the root untouched."""
+        rt = Runtime()
+        db = StateDB(rt)
+        rt.state.balances.mint("dave", 1_000)
+        root, _ = db.commit()
+        rt.state.balances.mint("erin", 2_000)
+        _, delta = db.commit()
+        db.revert(delta)
+        bad = delta + [("state", "nonces",
+                        checkpoint.canon_bytes("x"), None, b"\xff")]
+        with pytest.raises(ValueError):
+            db.apply(bad)
+        assert db.root_hex() == root
+        assert checkpoint.state_hash(rt) == root
+        assert "erin" not in rt.state.balances.accounts
+
+    def test_prove_and_stateless_verify(self):
+        rt = Runtime()
+        db = StateDB(rt)
+        rt.state.balances.mint("frank", 9_999)
+        root, _ = db.commit()
+        got = db.prove("state", "balances.accounts", key="frank")
+        present, acct = checkpoint.verify_read(
+            got["root"], "state", "balances.accounts", got["proof"],
+            key="frank")
+        assert present and acct.free == 9_999
+        # non-inclusion for an absent account
+        got = db.prove("state", "balances.accounts", key="nobody")
+        present, acct = checkpoint.verify_read(
+            got["root"], "state", "balances.accounts", got["proof"],
+            key="nobody")
+        assert (present, acct) == (False, None)
+        # whole-attribute leaf (key must be omitted)
+        got = db.prove("state", "randomness")
+        present, value = checkpoint.verify_read(
+            got["root"], "state", "randomness", got["proof"])
+        assert present and value == rt.state.randomness
+        with pytest.raises(ValueError):
+            db.prove("state", "balances.accounts")  # keyed: key required
+        with pytest.raises(ValueError):
+            db.prove("state", "randomness", key="x")  # one leaf: no key
+
+    def test_oracle_env_flag_detects_divergence(self):
+        rt = Runtime()
+        os.environ["CESS_STATE_ORACLE"] = "1"
+        try:
+            db = StateDB(rt)
+            rt.state.balances.mint("gina", 5)
+            db.commit()  # clean: oracle agrees
+            # bypass the tracked surfaces: corrupt the trie directly
+            db.smt.update({smt.key_path(b"evil"): b"evil"})
+            rt.state.balances.mint("gina", 5)
+            with pytest.raises(RuntimeError, match="state-trie divergence"):
+                db.commit()
+        finally:
+            del os.environ["CESS_STATE_ORACLE"]
+
+
+class TestBalancesReadPath:
+    """Satellite: reads must never mutate state (the pre-v7 account()
+    inserted an empty AccountData on first read, so a READ changed the
+    state hash)."""
+
+    def test_reads_of_absent_account_leave_state_hash_unchanged(self):
+        rt = Runtime()
+        db = StateDB(rt)
+        root = db.root_hex()
+        bal = rt.state.balances
+        for i in range(10):
+            acct = bal.account(f"ghost-{i}")
+            assert acct.free == 0 and acct.reserved == 0
+            assert bal.free(f"ghost-{i}") == 0
+            assert bal.reserved(f"ghost-{i}") == 0
+            assert not bal.can_slash(f"ghost-{i}", 1)
+        new_root, delta = db.commit()
+        assert new_root == root
+        assert delta == []
+        assert checkpoint.state_hash(rt) == root
+        for i in range(10):
+            assert f"ghost-{i}" not in bal.accounts
+
+    def test_mutators_still_work_through_wrapper(self):
+        rt = Runtime()
+        db = StateDB(rt)
+        bal = rt.state.balances
+        assert isinstance(bal.accounts, DirtyDict)
+        bal.mint("holly", 100)
+        bal.reserve("holly", 40)
+        assert bal.free("holly") == 60 and bal.reserved("holly") == 40
+        root, delta = db.commit()
+        assert root == checkpoint.state_hash(rt)
+        assert len(delta) >= 1
+
+
+# ------------------------------------------------------------- migration
+
+
+class TestV7Migration:
+    def test_v6_blob_restores_and_rehashes(self):
+        rt = Runtime()
+        rt.state.balances.mint("alice", 12_345)
+        rt.run_blocks(2)
+        blob = checkpoint.snapshot(rt)
+        want = checkpoint.state_hash(rt)
+        # a v6 blob is the same canonical payload under a v6 header
+        head = len(checkpoint.MAGIC)
+        v6 = checkpoint.MAGIC + (6).to_bytes(2, "big") + blob[head + 2:]
+        rt2 = Runtime()
+        checkpoint.restore(rt2, v6)
+        assert checkpoint.state_hash(rt2) == want
+        db = StateDB(rt2)
+        assert db.root_hex() == want
+
+    def test_blob_payload_hash_is_trie_root(self):
+        rt = Runtime()
+        rt.state.balances.mint("bob", 777)
+        blob, shash = checkpoint.snapshot_and_hash(rt)
+        assert shash == checkpoint.state_hash(rt)
+        assert checkpoint.blob_payload_hash(blob) == shash
+
+    def test_migration_registry_is_contiguous(self):
+        assert set(checkpoint.MIGRATIONS) == set(
+            range(1, checkpoint.FORMAT_VERSION))
+
+
+# --------------------------------------------------------- node lockstep
+
+
+class TestNodeLockstep:
+    def seed_chain(self, spec, blocks: int) -> NodeService:
+        node = make_node(spec, "alice")
+        slot = 0
+        while node.rt.state.block_number < blocks:
+            slot += 1
+            if node._slot_author(slot) == "alice":
+                node.produce_block(slot=slot)
+        return node
+
+    @pytest.fixture()
+    def single_validator_spec(self):
+        spec = make_spec()
+        spec.validators = ["alice"]
+        return spec
+
+    def test_three_node_replica_identical_roots(self, single_validator_spec):
+        """Lockstep: the author and two replicas report bit-identical
+        state roots at every height of the imported range."""
+        spec = single_validator_spec
+        author = self.seed_chain(spec, 6)
+        chain = [author.block_by_number[n] for n in range(1, 7)]
+        replicas = [make_node(spec, None) for _ in range(2)]
+        roots_by_height: dict[int, set[str]] = {}
+        for node in replicas:
+            for blk in chain:
+                assert node.import_block(blk) is not None
+                roots_by_height.setdefault(blk.number, set()).add(
+                    node.state_hash())
+        for blk in chain:
+            roots_by_height[blk.number].add(blk.state_hash)
+        for n, roots in roots_by_height.items():
+            assert len(roots) == 1, f"divergent roots at #{n}: {roots}"
+        # and the header root IS the incremental trie root of each node
+        for node in replicas + [author]:
+            assert node.state_hash() == chain[-1].state_hash
+            assert node.state_hash() == checkpoint.state_hash(node.rt)
+
+    def test_rollback_reinstate_roundtrip(self, single_validator_spec):
+        node = self.seed_chain(single_validator_spec, 3)
+        pre = node.state_hash()
+        with node._lock:
+            undo = node._rollback_head()
+            assert node.rt.state.block_number == 2
+            assert node.state_hash() == checkpoint.state_hash(node.rt)
+            node._reinstate_head(*undo)
+        assert node.state_hash() == pre
+        assert checkpoint.state_hash(node.rt) == pre
+
+    def test_e2e_stateless_account_read_against_justified_root(
+        self, single_validator_spec
+    ):
+        """The full v7 story over real RPC: a finalized header's
+        state_hash is the trie root, so a client holding ONLY that
+        justified header verifies an account read with no local state."""
+        spec = single_validator_spec
+        author = self.seed_chain(spec, 4)
+        assert author._finality_tick() is not None  # single-node quorum
+        assert author.finalized_number == 4
+        justified = author.block_by_number[4]
+        server = RpcServer(author, port=0)
+        server.start()
+        try:
+            from cess_tpu.node.rpc import rpc_call
+
+            root = rpc_call(server.host, server.port, "state_getRoot")
+            assert root == justified.state_hash
+            # the author's own account exists (it earns fees/rewards or
+            # at least has a nonce-free balance entry from authoring);
+            # prove a known-present and a known-absent key
+            got = rpc_call(server.host, server.port, "state_getProof",
+                           ["state", "nonces", "no-such-signer"])
+            present, _ = checkpoint.verify_read(
+                justified.state_hash, "state", "nonces", got["proof"],
+                key="no-such-signer")
+            assert present is False
+            got = rpc_call(server.host, server.port, "state_getProof",
+                           ["state", "block_number", None])
+            present, number = checkpoint.verify_read(
+                justified.state_hash, "state", "block_number",
+                got["proof"])
+            assert present and number == 4
+            # tamper with the served proof: the stateless client refuses
+            bad = dict(got["proof"])
+            if bad["siblings"]:
+                sibs = list(bad["siblings"])
+                sibs[0] = "00" * 32
+                bad["siblings"] = sibs
+            else:
+                bad["leafValue"] = (bad["leafValue"] or "") + "ff"
+            with pytest.raises(smt.ProofError):
+                checkpoint.verify_read(
+                    justified.state_hash, "state", "block_number", bad)
+        finally:
+            server.stop()
+
+    def test_sync_follower_tracks_roots(self, single_validator_spec):
+        spec = single_validator_spec
+        head = self.seed_chain(spec, 5)
+        server = RpcServer(head, port=0)
+        server.start()
+        try:
+            follower = make_node(spec, "bob")
+            sync = SyncManager(
+                follower, [(server.host, server.port)],
+                checkpoint_gap=50)
+            assert sync.catch_up() == 5
+            assert follower.state_hash() == head.state_hash()
+            assert follower.state_hash() == checkpoint.state_hash(
+                follower.rt)
+        finally:
+            server.stop()
