@@ -352,6 +352,314 @@ def bench_state(n_accounts: int) -> dict:
     }
 
 
+# ---------------------------------------------------------------- light
+
+
+def bench_light(n_justs: int = 64) -> dict:
+    """Read-plane A/B (cess_tpu/light/): the amortized cost of
+    verifying a finality justification serially (one aggregate pairing
+    each — what a light client or a naive follower pays) vs folded
+    through `verify_justifications_batch` at batch sizes 1/16/64 (one
+    weighted pairing per batch — what a read replica pays on a
+    catch-up range).  Host BLS pairings only, honest on any platform.
+
+    The timed set is n_justs HONEST justifications signed by the REAL
+    local-chain validator keys over distinct heights — the amortized
+    cost of a clean catch-up range, which is the path the speedup
+    claim is about (a refused batch deliberately falls back to serial
+    re-verification, so a planted forgery measures the fallback, not
+    the amortization).  Decision equivalence is asserted separately on
+    a MIXED set with a forged aggregate planted mid-range: serial and
+    every batch size must land on bit-identical accept/reject
+    vectors."""
+    import hashlib
+
+    from cess_tpu.node.chain_spec import dev_sk, local_spec
+    from cess_tpu.node.sync import (
+        Justification,
+        finality_payload,
+        verify_justification,
+        verify_justifications_batch,
+    )
+    from cess_tpu.ops import bls12_381 as bls
+    from cess_tpu.ops.bls_agg import aggregate_signatures
+
+    reps = max(1, int(os.environ.get("BENCH_LIGHT_REPS", "3")))
+    spec = local_spec()
+    genesis = spec.genesis_hash()
+    validators = sorted(spec.validators)
+    keys = spec.validator_keys()
+    sks = {v: dev_sk(v, spec.chain_id) for v in validators}
+
+    t0 = time.perf_counter()
+    justs = []
+    for n in range(1, n_justs + 1):
+        bh = hashlib.blake2b(
+            f"light-bench-block-{n}".encode(), digest_size=32
+        ).hexdigest()
+        payload = finality_payload(genesis, n, bh)
+        agg = aggregate_signatures(
+            [bls.sign(sks[v], payload) for v in validators])
+        justs.append(Justification(
+            number=n, block_hash=bh, signers=list(validators),
+            agg_sig=agg.hex()))
+    log(f"light justgen: {n_justs} justifications x "
+        f"{len(validators)} signers in {time.perf_counter() - t0:.2f}s")
+
+    serial_runs = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        got = [verify_justification(j, genesis, validators, keys)
+               for j in justs]
+        serial_runs.append(time.perf_counter() - t0)
+        assert got == [True] * n_justs, "serial verdicts diverged"
+    serial_med, serial_spread = _median_spread(serial_runs)
+    log(f"light before (serial, 1 pairing/justification): median "
+        f"{serial_med:.2f}s ({1000 * serial_med / n_justs:.1f} "
+        f"ms/justification)")
+
+    batches = {}
+    for size in (1, 16, 64):
+        runs = []
+        pairings = 0
+        for _ in range(reps):
+            stats = {"pairings": 0}
+            t0 = time.perf_counter()
+            got = []
+            for i in range(0, n_justs, size):
+                got.extend(verify_justifications_batch(
+                    justs[i:i + size], genesis, validators, keys,
+                    stats=stats))
+            runs.append(time.perf_counter() - t0)
+            pairings = stats["pairings"]
+            assert got == [True] * n_justs, \
+                f"batch-{size} verdicts diverged from serial"
+        med, spread = _median_spread(runs)
+        log(f"light after (batch {size}, {pairings} pairings): median "
+            f"{med:.2f}s ({1000 * med / n_justs:.1f} ms/justification, "
+            f"{serial_med / med:.1f}x)")
+        batches[f"batch_{size}"] = {
+            "median_s": round(med, 3),
+            "spread_s": round(spread, 3),
+            "runs_s": [round(t, 3) for t in runs],
+            "ms_per_justification": round(1000 * med / n_justs, 2),
+            "pairings_per_run": pairings,
+            "speedup_vs_serial": round(serial_med / med, 2),
+        }
+
+    speedup64 = serial_med / (batches["batch_64"]["median_s"] or 1e-9)
+    assert speedup64 >= 5.0, (
+        f"batch-64 amortized speedup {speedup64:.1f}x below the 5x "
+        "acceptance floor")
+
+    # decision equivalence on a MIXED set: one forged aggregate (a
+    # valid G1 point over the WRONG payload) planted mid-range — the
+    # serial path rejects exactly it, and every batch size must fall
+    # back and land on the same verdict vector, bit for bit
+    forged_at = n_justs // 2
+    mixed = list(justs)
+    mixed[forged_at] = Justification(
+        number=mixed[forged_at].number,
+        block_hash=mixed[forged_at].block_hash,
+        signers=list(validators), agg_sig=mixed[0].agg_sig)
+    expected = [i != forged_at for i in range(n_justs)]
+    got = [verify_justification(j, genesis, validators, keys)
+           for j in mixed]
+    assert got == expected, "serial verdicts on the mixed set diverged"
+    for size in (1, 16, 64):
+        got = []
+        for i in range(0, n_justs, size):
+            got.extend(verify_justifications_batch(
+                mixed[i:i + size], genesis, validators, keys))
+        assert got == expected, (
+            f"batch-{size} verdicts on the mixed set diverged from "
+            "serial")
+    log("light decision equivalence: serial == batch 1/16/64 on the "
+        f"forged-at-#{mixed[forged_at].number} mixed set")
+
+    return {
+        "justifications": n_justs,
+        "signers": len(validators),
+        "reps": reps,
+        "mixed_set_forged_at": forged_at,
+        "decisions_bit_identical": True,
+        "before_serial": {
+            "median_s": round(serial_med, 3),
+            "spread_s": round(serial_spread, 3),
+            "runs_s": [round(t, 3) for t in serial_runs],
+            "ms_per_justification": round(
+                1000 * serial_med / n_justs, 2),
+        },
+        "after_batched": batches,
+        "speedup_batch64": round(speedup64, 2),
+    }
+
+
+def bench_light_scaling() -> dict:
+    """Read-plane horizontal scaling, measured over the real wire: a
+    2-validator chain with TWO `--replica` processes, a fleet of
+    verifying light clients (tools/read_loadgen.py) pointed at one
+    replica vs spread across both.  Every counted read is a
+    proof-batch round trip VERIFIED against the client's own justified
+    anchor — replica count, not validator count, is the scaling knob,
+    and the validator set never sees a read.
+
+    The validators are SIGSTOPped during measurement (the read tier
+    serves FINALIZED state; a quiesced consensus tier changes nothing
+    a client verifies) so the numbers are not noise from block
+    authoring.  Honesty gate, same spirit as vs_baseline=None off-TPU:
+    two CPU-bound replica processes can only outserve one when the
+    host actually has cores to put them on, so the strict two>one
+    assertion applies on hosts with >= 4 cores; below that the bench
+    records both arms and asserts adding a replica does not COLLAPSE
+    service."""
+    import signal
+    import socket
+    import subprocess
+    import tempfile
+
+    from cess_tpu.node.chain_spec import _spec, load_spec
+    from cess_tpu.node.rpc import RpcError, rpc_call
+    from tools.read_loadgen import run_load
+
+    host = "127.0.0.1"
+    validators = ["alice", "bob"]
+    clients = max(2, int(os.environ.get("BENCH_LIGHT_CLIENTS", "8")))
+    reads = max(1, int(os.environ.get("BENCH_LIGHT_READS", "20")))
+    reps = max(1, int(os.environ.get("BENCH_LIGHT_REPS", "3")))
+
+    socks = [socket.socket() for _ in range(4)]
+    for s in socks:
+        s.bind((host, 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    vports, rports = ports[:2], ports[2:]
+
+    spec = _spec("light-bench", "CESS-TPU Light Bench",
+                 accounts=validators, validators=validators,
+                 block_time_ms=500)
+    spec.finality_period = 4
+    spec_file = tempfile.NamedTemporaryFile(
+        "w", suffix="-light-bench.json", delete=False)
+    spec_file.write(spec.to_json())
+    spec_file.close()
+
+    def launch(port, peers, authority=None):
+        cmd = [sys.executable, "-m", "cess_tpu", "run",
+               "--chain", spec_file.name, "--rpc-port", str(port),
+               "--peers", ",".join(f"{host}:{p}" for p in peers)]
+        cmd += (["--authority", authority] if authority
+                else ["--replica"])
+        return subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+
+    def wait_for(pred, timeout, what):
+        t0 = time.monotonic()
+        while not pred():
+            if time.monotonic() - t0 > timeout:
+                raise TimeoutError(f"light bench: {what}")
+            time.sleep(0.5)
+
+    def finalized(port):
+        try:
+            return rpc_call(host, port, "sync_status", [],
+                            timeout=3.0)["finalized"]["number"]
+        except (OSError, RpcError):
+            return -1
+
+    procs = []
+    try:
+        for v, p in zip(validators, vports):
+            procs.append(launch(p, [q for q in vports if q != p],
+                                authority=v))
+        for p in rports:
+            procs.append(launch(p, vports))
+
+        def rpc_up(port):
+            try:
+                rpc_call(host, port, "system_name", [], timeout=2.0)
+                return True
+            except (OSError, RpcError):
+                return False
+
+        # two phases: process + JAX startup first (4 interpreters
+        # compete for the host), THEN the chain actually finalizing
+        wait_for(lambda: all(rpc_up(p) for p in vports + rports),
+                 180, "nodes answering rpc")
+        wait_for(lambda: min(finalized(p) for p in rports) >= 4,
+                 240, "replicas finalizing")
+        loaded_spec = load_spec(spec_file.name)
+
+        # quiesce the consensus tier: replicas serve finalized state,
+        # so stopped validators change nothing a client verifies —
+        # they just stop stealing cycles from the measurement
+        n_validators = len(validators)
+        for proc in procs[:n_validators]:
+            proc.send_signal(signal.SIGSTOP)
+
+        one_runs, two_runs = [], []
+        for _ in range(reps):
+            # alternate single/both so host cache state is spread
+            # evenly across the two arms
+            one = run_load([(host, rports[0])], loaded_spec,
+                           clients=clients, reads=reads, timeout=15.0)
+            two = run_load([(host, rports[0]), (host, rports[1])],
+                           loaded_spec, clients=clients, reads=reads,
+                           timeout=15.0)
+            assert one["errors"] == 0 and two["errors"] == 0, \
+                "verified-read errors under load"
+            one_runs.append(one["rps"])
+            two_runs.append(two["rps"])
+        one_med, _ = _median_spread(one_runs)
+        two_med, _ = _median_spread(two_runs)
+        cores = os.cpu_count() or 1
+        parallel_host = cores >= 4
+        log(f"light scaling: {clients} clients x {reads} proof-batch "
+            f"reads — 1 replica {one_med:.0f} rps, 2 replicas "
+            f"{two_med:.0f} rps ({two_med / one_med:.2f}x, "
+            f"{cores} host cores)")
+        if parallel_host:
+            assert two_med > one_med, (
+                f"two replicas ({two_med} rps) must outserve one "
+                f"({one_med} rps)")
+        else:
+            # one core: both replicas share it, so only assert the
+            # second replica costs (roughly) nothing
+            assert two_med >= 0.6 * one_med, (
+                f"adding a replica collapsed service: {two_med} vs "
+                f"{one_med} rps")
+            log("light scaling: < 4 host cores — recording both arms, "
+                "strict two>one assertion needs real parallelism")
+        return {
+            "validators": n_validators,
+            "clients": clients,
+            "reads_per_client": reads,
+            "reps": reps,
+            "host_cores": cores,
+            "one_replica_rps": {
+                "median": round(one_med, 2),
+                "runs": [round(r, 2) for r in one_runs],
+            },
+            "two_replica_rps": {
+                "median": round(two_med, 2),
+                "runs": [round(r, 2) for r in two_runs],
+            },
+            "scaling": round(two_med / one_med, 2),
+            "scaling_asserted": parallel_host,
+        }
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+        for proc in procs:
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                pass
+        os.unlink(spec_file.name)
+
+
 # ---------------------------------------------------------------- verify
 
 
@@ -501,6 +809,21 @@ def main() -> None:
             "platform": jax.default_backend(),
             "vs_baseline": None,
             "state": st,
+        }))
+        return
+    if os.environ.get("BENCH_ONLY", "") == "light":
+        # read-plane A/B (host pairings + subprocess testnet — honest
+        # on any platform, so no vs_baseline ratio is claimed)
+        li = bench_light(
+            max(2, int(os.environ.get("BENCH_LIGHT_JUSTS", "64"))))
+        li["scaling"] = bench_light_scaling()
+        print(json.dumps({
+            "metric": f"light_batch64_{li['justifications']}justs_s",
+            "value": li["after_batched"]["batch_64"]["median_s"],
+            "unit": "s",
+            "platform": jax.default_backend(),
+            "vs_baseline": None,
+            "light": li,
         }))
         return
     n_proofs = int(os.environ.get("BENCH_PROOFS", "1024"))

@@ -280,6 +280,27 @@ def verify_read(
     return present, decode_value(enc) if present else None
 
 
+def verify_read_batch(
+    root_hex: str,
+    reads: list[tuple[str, str, Any]],
+    proof_wires: list[dict],
+) -> list[tuple[bool, Any]]:
+    """verify_read over a `state_getProofBatch` reply: one (present,
+    value) per (pallet, attr, key) read, EVERY wire checked against the
+    same root — the caller's justified anchor, not whatever root the
+    server claims.  Raises smt.ProofError on the first wire that does
+    not commit to it, and ValueError on a length mismatch (a server
+    that answered a different batch)."""
+    if len(reads) != len(proof_wires):
+        raise ValueError(
+            f"{len(proof_wires)} proofs for {len(reads)} reads"
+        )
+    return [
+        verify_read(root_hex, pallet, attr, wire, key=key)
+        for (pallet, attr, key), wire in zip(reads, proof_wires)
+    ]
+
+
 def _apply(obj: Any, data: dict[str, Any]) -> None:
     for name, value in data.items():
         if (

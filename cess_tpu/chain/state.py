@@ -333,6 +333,12 @@ class StateDB:
     def root_hex(self) -> str:
         return self.smt.root().hex()
 
+    def leaf_encodings(self) -> dict[bytes, bytes]:
+        """Snapshot of path → value encoding for every leaf — the seed
+        of a read replica's FINALIZED view (light/replica.py), which
+        from there advances by per-block deltas only."""
+        return dict(self._enc)
+
     def check_oracle(self) -> str:
         """Assert the incremental root equals the full-rebuild oracle —
         loud, because a divergence means the dirty tracking missed a

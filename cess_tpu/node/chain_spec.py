@@ -120,6 +120,24 @@ class ChainSpec:
             setattr(cfg, k, v)
         return cfg
 
+    def genesis_hash(self) -> str:
+        """blake2b over the spec document — block #1's parent and the
+        domain separator every consensus payload binds.  NodeService
+        adopts this as `self.genesis`; a light client needs nothing
+        else chain-side to start verifying (light/client.py)."""
+        import hashlib
+
+        return hashlib.blake2b(
+            self.to_json().encode(), digest_size=32
+        ).hexdigest()
+
+    def validator_keys(self) -> dict[str, bytes]:
+        """validator name → BLS public key — the initial trusted keyset
+        a light client anchors on (public_keys restricted to the
+        authority set)."""
+        keys = self.public_keys()
+        return {v: keys[v] for v in self.validators if v in keys}
+
     def public_keys(self) -> dict[str, bytes]:
         """account → BLS public key (the extrinsic-signature registry)."""
         out = {}

@@ -46,12 +46,25 @@ def _cmd_run(args) -> int:
         spec.block_time_ms = args.block_time_ms
     if args.finality_period is not None:
         spec.finality_period = args.finality_period
-    service = NodeService(
-        spec, authority=args.authority,
-        pool_max_count=args.pool_max_count,
-        pool_max_bytes=args.pool_max_bytes,
-        import_batch_max=args.import_batch_max,
-    )
+    if args.replica:
+        from ..light import ReplicaService
+
+        if args.authority:
+            print("--replica is keyless; ignoring --authority "
+                  f"{args.authority}", file=sys.stderr)
+        service = ReplicaService(
+            spec,
+            pool_max_count=args.pool_max_count,
+            pool_max_bytes=args.pool_max_bytes,
+            import_batch_max=args.import_batch_max,
+        )
+    else:
+        service = NodeService(
+            spec, authority=args.authority,
+            pool_max_count=args.pool_max_count,
+            pool_max_bytes=args.pool_max_bytes,
+            import_batch_max=args.import_batch_max,
+        )
     service.chaos_mute = bool(args.chaos_mute)
     faults = None
     spam = None
@@ -97,6 +110,7 @@ def _cmd_run(args) -> int:
         f"cess-tpu-node: chain={spec.chain_id} rpc={server.host}:{server.port}"
         f" block_time={spec.block_time_ms}ms"
         f" peers={len(service.sync.peers) if service.sync else 0}"
+        f"{' REPLICA (keyless read plane)' if args.replica else ''}"
         f"{chaos}{' MUTED' if args.chaos_mute else ''}",
         flush=True,
     )
@@ -269,13 +283,45 @@ def _cmd_proof(args) -> int:
     node, the former does not."""
     from ..chain.checkpoint import verify_read
     from ..chain.smt import ProofError
-    from .rpc import rpc_call
+    from .rpc import RpcError, rpc_call
 
     key = json.loads(args.key) if args.key is not None else None
-    got = rpc_call(args.host, args.port, "state_getProof",
+    host, port = args.host, args.port
+    if args.rpc:
+        h, _, p = args.rpc.rpartition(":")
+        host, port = (h or "127.0.0.1"), int(p)
+    if args.light:
+        # fully stateless trust path: anchor on a verified justification
+        # pulled from the replica, then verify the read against the
+        # client's OWN justified root (light/client.py) — nothing the
+        # server claims is believed
+        from ..light import LightClient, LightClientError
+        from .chain_spec import load_spec
+
+        lc = LightClient.from_spec(load_spec(args.chain), host, port)
+        try:
+            anchor = lc.sync()
+            present, value = lc.read(args.pallet, args.attr, key=key)
+        except (LightClientError, RpcError, OSError) as e:
+            print(f"INVALID: {e}", file=sys.stderr)
+            return 1
+        print(json.dumps({
+            "root": anchor["root"],
+            "rootSource": "justified (light client)",
+            "anchor": {"number": anchor["number"],
+                       "hash": anchor["hash"]},
+            "justificationsVerified": lc.justifications_verified,
+            "pallet": args.pallet,
+            "attr": args.attr,
+            "key": key,
+            "present": present,
+            "value": repr(value) if present else None,
+        }, indent=2, sort_keys=True))
+        return 0
+    got = rpc_call(host, port, "state_getProof",
                    [args.pallet, args.attr, key])
     root = args.root if args.root else rpc_call(
-        args.host, args.port, "state_getRoot")
+        host, port, "state_getRoot")
     try:
         present, value = verify_read(
             root, args.pallet, args.attr, got["proof"], key=key)
@@ -314,6 +360,11 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--rpc-port", type=int, default=9944)
     run.add_argument("--authority", default=None,
                      help="author only this validator's slots")
+    run.add_argument("--replica", action="store_true",
+                     help="run a KEYLESS read replica (light/replica.py):"
+                          " follows finality via batched justification "
+                          "verification and serves read proofs against "
+                          "the finalized root — never signs anything")
     run.add_argument("--blocks", type=int, default=0,
                      help="stop after N blocks (0 = run forever)")
     run.add_argument("--block-time-ms", type=int, default=0)
@@ -412,6 +463,18 @@ def build_parser() -> argparse.ArgumentParser:
                          "the node's own head root is used — which "
                          "trusts the node and only smoke-tests the "
                          "proof plumbing")
+    pr.add_argument("--light", action="store_true",
+                    help="verify as a stateless light client: anchor on "
+                         "a justification verified against the spec's "
+                         "validator keyset, then check the proof "
+                         "against that justified root (trusts only "
+                         "--chain genesis + keys, never the server)")
+    pr.add_argument("--chain", default="dev",
+                    help="chain spec for --light trust anchors "
+                         "(genesis hash + initial validator keys)")
+    pr.add_argument("--rpc", default=None,
+                    help="host:port of the replica to query "
+                         "(overrides --host/--port)")
     pr.add_argument("pallet", help='pallet name, e.g. "state"')
     pr.add_argument("attr",
                     help='attribute path, e.g. "balances.accounts"')

@@ -17,9 +17,15 @@ import json
 import socket
 import socketserver
 import threading
+import time
 from typing import Any, Callable
 
 from .service import Extrinsic, FeeTooLow, NodeService, PoolFull
+
+# Most reads a state_getProofBatch request may prove in one round trip
+# (one lock hold, one shared root).  Oversized batches are refused with
+# the typed -32013 so a light client can split instead of guessing.
+PROOF_BATCH_MAX = 64
 
 
 class RpcError(Exception):
@@ -196,18 +202,87 @@ class RpcApi:
             with s._lock:
                 return s.statedb.root_hex()
 
+        def _prover():  # holds-lock: _lock
+            """The commitment read proofs are served from: a replica's
+            FINALIZED view (light/replica.py — every proof verifies
+            against a root a light client can justify for itself),
+            else the head-state trie."""
+            plane = getattr(s, "read_plane", None)
+            return plane if plane is not None else s.statedb
+
+        def _count_read(n: int, seconds: float) -> None:
+            """Replica read-plane metrics, when this service carries
+            them (ReplicaService): served proofs + build time."""
+            reads = getattr(s, "m_replica_reads", None)
+            if reads is not None:
+                reads.inc(n)
+                s.m_replica_proof.observe(seconds)
+
         @method("state_getProof")
         def _sproof(pallet: str, attr: str, key=None):
-            """Merkle read proof for one state entry against the head
-            root (chain/smt.py wire form).  `key` is required for keyed
-            maps (balances.accounts, nonces, deal_map, file) and must
-            be omitted for whole-attribute leaves.  Verify standalone
+            """Merkle read proof for one state entry (chain/smt.py wire
+            form) — against the FINALIZED root on a replica, the head
+            root otherwise.  `key` is required for keyed maps
+            (balances.accounts, nonces, deal_map, file) and must be
+            omitted for whole-attribute leaves.  Verify standalone
             with chain/checkpoint.py verify_read — no local state."""
+            t0 = time.perf_counter()
             with s._lock:
                 try:
-                    return s.statedb.prove(pallet, attr, key=key)
+                    out = _prover().prove(pallet, attr, key=key)
                 except (ValueError, AttributeError) as e:
                     raise RpcError(-32602, str(e))
+            _count_read(1, time.perf_counter() - t0)
+            return out
+
+        @method("state_getProofBatch")
+        def _sproof_batch(reads, root=None):
+            """N read proofs against ONE root in one round trip — the
+            light-client read path (light/client.py).  `reads` is a
+            list of [pallet, attr, key-or-null] entries, all proven
+            under a single lock hold so every wire commits to the
+            returned root.  A caller that pins `root` (its justified
+            anchor) is refused with -32014 when the serving root has
+            moved past it — the client re-anchors and retries — and a
+            batch above PROOF_BATCH_MAX is refused with -32013; both
+            codes are typed so clients can react without string
+            matching (docs/rpc.md)."""
+            if not isinstance(reads, list) or not reads:
+                raise RpcError(-32602, "reads must be a non-empty list")
+            if len(reads) > PROOF_BATCH_MAX:
+                raise RpcError(
+                    -32013,
+                    f"proof batch too large: {len(reads)} reads > "
+                    f"max {PROOF_BATCH_MAX}")
+            for r in reads:
+                if not isinstance(r, (list, tuple)) or not 2 <= len(r) <= 3:
+                    raise RpcError(
+                        -32602,
+                        "each read must be [pallet, attr, key-or-null]")
+            t0 = time.perf_counter()
+            with s._lock:
+                prover = _prover()
+                serving = prover.root_hex()
+                if root is not None and root != serving:
+                    raise RpcError(
+                        -32014,
+                        f"root mismatch: serving {serving}, "
+                        f"requested {root}")
+                proofs = []
+                try:
+                    for r in reads:
+                        pallet, attr = r[0], r[1]
+                        key = r[2] if len(r) == 3 else None
+                        proofs.append(prover.prove(pallet, attr, key=key))
+                except (ValueError, AttributeError, TypeError) as e:
+                    raise RpcError(-32602, str(e))
+            if any(p["root"] != serving for p in proofs):
+                # cannot happen under the single lock hold above; kept
+                # as a hard guard so a future prover that releases the
+                # lock mid-batch fails loudly instead of mixing roots
+                raise RpcError(-32014, "mixed-root batch")
+            _count_read(len(reads), time.perf_counter() - t0)
+            return {"root": serving, "proofs": proofs}
 
         @method("state_getEvents")
         def _events(last: int = 20):
@@ -567,8 +642,16 @@ class RpcApi:
                 blob = None
                 if head is not None and just is not None:
                     bh = head.hash(s.genesis)
-                    if bh == s.finalized_hash:
-                        blob = s._state_blobs.get(bh)
+                    if (bh == s.finalized_hash
+                            and number == s.rt.state.block_number):
+                        # the finalized anchor IS the current head, so
+                        # its post-state is exportable directly.  (A
+                        # finalized block BEHIND head has no full blob
+                        # any more — the per-block blob cache became
+                        # leaf deltas — so fall through to the
+                        # unjustified-head path below and let the
+                        # receiver replay blocks instead.)
+                        blob = s.export_state()
                 if blob is None:
                     # nothing finalized (or blob evicted): the receiver
                     # will reject an unjustified anchor and fall back to
@@ -633,6 +716,64 @@ class RpcApi:
         @method("chain_finalized_head")
         def _finalized():
             return {"number": s.finalized_number, "hash": s.finalized_hash}
+
+        @method("chain_getJustification")
+        def _get_justification(ref=None):
+            """Pull-RPC finality feed (light/client.py): justifications
+            were push-only gossip before this — a stateless client (or
+            an observer the validators never knew about) can now ASK.
+            `ref` is a block number, a block hash, or null for the
+            latest held justification.  The per-height store is
+            bounded (service.JUST_RETENTION_BLOCKS): pruned or
+            never-held heights answer -32004 and the client re-anchors
+            from a newer justification."""
+            with s._lock:
+                just = None
+                if ref is None:
+                    if s.justifications:
+                        just = s.justifications[max(s.justifications)]
+                elif isinstance(ref, bool):
+                    pass  # bool is an int subclass; refuse it as a ref
+                elif isinstance(ref, int) or (
+                    isinstance(ref, str) and ref.isdigit()
+                ):
+                    just = s.justifications.get(int(ref))
+                elif isinstance(ref, str):
+                    just = next(
+                        (j for j in s.justifications.values()
+                         if j.block_hash == ref), None)
+            if just is None:
+                raise RpcError(-32004, "justification not held")
+            return just.to_json()
+
+        @method("light_syncHeaders")
+        def _light_headers(start: int, count: int = 1):
+            """Finality-proof-carrying HEADER range for light clients:
+            each entry is {header, justification-or-null}, the body
+            replaced by its extRoot commitment so the client recomputes
+            every block hash (sync.header_hash) — and checks each
+            justification really covers its header — without
+            downloading extrinsics.  Capped at SYNC_RANGE_MAX like
+            sync_block_range."""
+            from .sync import SYNC_RANGE_MAX
+
+            out = []
+            start = int(start)
+            with s._lock:
+                for n in range(
+                    start, start + min(int(count), SYNC_RANGE_MAX)
+                ):
+                    blk = s.block_by_number.get(n)
+                    if blk is None:
+                        break
+                    just = s.justifications.get(n)
+                    out.append({
+                        "header": blk.header_json(),
+                        "justification": (
+                            None if just is None else just.to_json()
+                        ),
+                    })
+            return out
 
         # ---- audit offchain views (what the miner/TEE role clients
         # poll to drive a live audit round)

@@ -643,6 +643,15 @@ REJECT_CACHE_MAX = 8192
 IMPORT_BATCH_MAX = 64
 IMPORT_RESULT_CACHE_MAX = 2048
 
+# Pull-RPC justification retention (chain_getJustification): one
+# justification lands every finality_period blocks, and light clients
+# re-anchor from RECENT ones — so the in-memory per-height store keeps
+# a bounded window below the finalized head and prunes the rest (the
+# full history stays in the store's journal, when one is attached).
+# Heights pruned here answer -32004 over RPC; a light client simply
+# re-anchors from a newer justification.
+JUST_RETENTION_BLOCKS = 1024
+
 
 class NodeService:
     """One chain node: Runtime + pool + block authoring + state export.
@@ -676,9 +685,7 @@ class NodeService:
             ias_roots = ias.RootStore.from_der([root_der])
         self.rt = Runtime(spec.runtime_config(ias_roots=ias_roots))
         self.keys = spec.public_keys()
-        self.genesis = hashlib.blake2b(
-            spec.to_json().encode(), digest_size=32
-        ).hexdigest()
+        self.genesis = spec.genesis_hash()
         # Evidence wiring (chain/offences.py): the pallet re-verifies
         # every offence report against THIS chain's genesis and key
         # registry before anything is queued — an unverifiable report
@@ -2360,6 +2367,7 @@ class NodeService:
             self.finalized_number = just.number
             self.finalized_hash = just.block_hash
             self.justifications[just.number] = just
+            self._prune_justifications()
             self.m_finalized.set(just.number)
             self.m_finality_lag.set(
                 self.rt.state.block_number - just.number)
@@ -2391,6 +2399,30 @@ class NodeService:
             if self.store is not None:
                 self.store.journal_justification(just)
         return True
+
+    def _prune_justifications(self) -> None:  # holds-lock: _lock
+        """Drop held justifications below the retention horizon
+        (JUST_RETENTION_BLOCKS under the finalized head): the
+        chain_getJustification store must stay bounded on a
+        long-running node — one entry lands every finality period."""
+        floor = self.finalized_number - JUST_RETENTION_BLOCKS
+        if floor <= 0:
+            return
+        for n in [n for n in self.justifications if n < floor]:
+            del self.justifications[n]
+
+    def handle_justifications(self, justs: list[Justification]) -> int:
+        """Apply a batch of pulled justifications (catch-up ranges,
+        sync.SyncManager._batch_import) in height order; returns how
+        many advanced the finalized head.  The base service verifies
+        each serially — a read replica (light/replica.py
+        ReplicaService) overrides this to fold the whole batch's
+        aggregate checks into ONE weighted pairing."""
+        advanced = 0
+        for just in sorted(justs, key=lambda j: j.number):
+            if self.handle_justification(just):
+                advanced += 1
+        return advanced
 
     # ------------------------------------------------------ offences
 

@@ -132,6 +132,33 @@ def extrinsic_root(extrinsics: list[dict]) -> str:
     ).hexdigest()
 
 
+def header_signing_payload(genesis: str, hdr: dict) -> bytes:
+    """THE block signing payload, built from a header wire dict
+    (Block.header_json): the served `extRoot` stands in for
+    extrinsic_root(body).  `Block.signing_payload` routes through here,
+    so a stateless client folding a served header signs off on exactly
+    the bytes the author signed."""
+    return canonical_json(
+        [
+            genesis, "block", int(hdr["number"]), int(hdr["slot"]),
+            str(hdr["parent"]), str(hdr["author"]), str(hdr["extRoot"]),
+            str(hdr["stateHash"]), str(hdr.get("vrfOut", "")),
+            str(hdr.get("vrfProof", "")),
+        ]
+    )
+
+
+def header_hash(genesis: str, hdr: dict) -> str:
+    """Block hash recomputed from a HEADER wire dict — what a light
+    client checks a justification's block_hash against.  Raises
+    KeyError/ValueError/TypeError on a malformed header."""
+    return hashlib.blake2b(
+        header_signing_payload(genesis, hdr)
+        + bytes.fromhex(str(hdr["sig"])),
+        digest_size=32,
+    ).hexdigest()
+
+
 @dataclass
 class Block:
     """One announced block: header fields + full body.  `state_hash` is
@@ -153,13 +180,25 @@ class Block:
     vrf_proof: str = ""   # hex 48-byte compressed G1 proof point
 
     def signing_payload(self, genesis: str) -> bytes:
-        return canonical_json(
-            [
-                genesis, "block", self.number, self.slot, self.parent,
-                self.author, extrinsic_root(self.extrinsics),
-                self.state_hash, self.vrf_output, self.vrf_proof,
-            ]
-        )
+        # delegated through the header wire form so the two can never
+        # drift: a light client recomputing the hash from a served
+        # header (header_hash) folds the exact same canonical bytes
+        return header_signing_payload(genesis, self.header_json())
+
+    def header_json(self) -> dict:
+        """Header-only wire form (the `light_syncHeaders` feed): the
+        body is replaced by its extrinsic-root commitment, so a light
+        client recomputes the block hash — and therefore checks a
+        justification really covers this header — without downloading
+        the extrinsics."""
+        return {
+            "number": self.number, "slot": self.slot,
+            "parent": self.parent, "author": self.author,
+            "stateHash": self.state_hash,
+            "extRoot": extrinsic_root(self.extrinsics),
+            "sig": self.signature,
+            "vrfOut": self.vrf_output, "vrfProof": self.vrf_proof,
+        }
 
     def sign(self, sk: int, genesis: str) -> "Block":
         self.signature = bls.sign(sk, self.signing_payload(genesis)).hex()
@@ -312,6 +351,106 @@ def verify_justification(
     except ValueError:
         return False
     return bls_agg.verify_aggregate(pks, [payload] * len(pks), agg)
+
+
+def _justification_triple(
+    just: Justification,
+    genesis: str,
+    validators: list[str],
+    keys: dict[str, bytes],
+    pk_memo: dict[tuple, bytes],
+) -> tuple[bytes, bytes, bytes] | None:
+    """Fold one justification to a single (Σpk, payload, agg_sig)
+    SigTriple, or None when a pre-pairing check fails.  The structural
+    checks here mirror `verify_justification` EXACTLY (distinct
+    signers, subset of the authority set, 2/3 quorum, known keys,
+    parseable hex) — that equivalence is what makes the batch verdict
+    bit-identical to the serial one.  `pk_memo` shares the summed key
+    across justifications with the same signer set, so the batch
+    check's per-distinct-key G2 decompression is paid once per SET,
+    not once per justification."""
+    signers = just.signers
+    if len(set(signers)) != len(signers):
+        return None
+    if not set(signers) <= set(validators):
+        return None
+    if not quorum(len(signers), len(validators)):
+        return None
+    memo_key = tuple(signers)
+    agg_pk = pk_memo.get(memo_key)
+    if agg_pk is None:
+        pks = []
+        for s in signers:
+            pk = keys.get(s)
+            if pk is None:
+                return None
+            pks.append(pk)
+        try:
+            agg_pk = bls_agg.aggregate_pubkeys(pks)
+        except ValueError:
+            return None
+        pk_memo[memo_key] = agg_pk
+    try:
+        sig = bytes.fromhex(just.agg_sig)
+    except ValueError:
+        return None
+    return (
+        agg_pk,
+        finality_payload(genesis, just.number, just.block_hash),
+        sig,
+    )
+
+
+def verify_justifications_batch(
+    justs: list[Justification],
+    genesis: str,
+    validators: list[str],
+    keys: dict[str, bytes],
+    seed: bytes = b"",
+    stats: dict | None = None,
+) -> list[bool]:
+    """Per-justification verdicts for a whole batch in ONE weighted
+    pairing product — the replica's finality plane (light/replica.py).
+
+    Each justification reduces to one SigTriple under the summed
+    signer key (bls_agg.aggregate_pubkeys): the aggregate equation
+    e(agg, −g2) · e(H(payload), Σpk) == 1 IS the single-signature
+    equation, so N justifications cost one `verify_batch_host` call —
+    and identical signer sets (the steady-state case: the same 2/3
+    quorum every period) share one G2 decompression inside it.  A
+    refused batch falls back to the serial verifier per structurally
+    valid item, so accept/reject decisions are bit-identical to
+    calling `verify_justification` one at a time — asserted in
+    tests/test_light.py and bench.py's BENCH_ONLY=light A/B.
+
+    `stats`, when given, accumulates "pairings": the number of pairing
+    checks evaluated (1 for an accepted batch; 1 + one per candidate
+    on the fallback path) — the cess_light_batch_pairings feed."""
+    verdicts = [False] * len(justs)
+    pk_memo: dict[tuple, bytes] = {}
+    triples: list[tuple[bytes, bytes, bytes]] = []
+    idx: list[int] = []
+    for i, just in enumerate(justs):
+        t = _justification_triple(just, genesis, validators, keys, pk_memo)
+        if t is not None:
+            triples.append(t)
+            idx.append(i)
+    if not triples:
+        return verdicts
+    if stats is not None:
+        stats["pairings"] = stats.get("pairings", 0) + 1
+    if bls_agg.verify_batch_host(triples, seed):
+        for i in idx:
+            verdicts[i] = True
+        return verdicts
+    # refused batch: isolate per justification, bit-identical to serial
+    for i in idx:
+        if stats is not None:
+            stats["pairings"] = stats.get("pairings", 0) + 1
+        verdicts[i] = verify_justification(
+            justs[i], genesis, validators, keys
+        )
+    return verdicts
 
 
 # ------------------------------------------------------------ sync manager
@@ -745,6 +884,7 @@ class SyncManager:
         outcomes = s.import_batch(blocks, traces=traces,
                                   origin="catchup-batch")
         imported = 0
+        range_justs: list[Justification] = []
         for (kind, payload), just in zip(outcomes, justs):
             if kind in ("rejected", "gap"):
                 # a refusal (or a gap a rejection opened) ends this
@@ -753,9 +893,7 @@ class SyncManager:
                 break
             if just:
                 try:
-                    s.handle_justification(
-                        Justification.from_json(just)
-                    )
+                    range_justs.append(Justification.from_json(just))
                 except (KeyError, TypeError, ValueError):
                     pass
             if kind == "imported":
@@ -766,6 +904,12 @@ class SyncManager:
                 # must not read as "rode the batch"
                 if getattr(payload, "batch_verified", False):
                     self.batched_imports += 1
+        # hand the range's justifications over as ONE batch: the base
+        # service verifies them serially, a read replica
+        # (light/replica.py) folds the whole batch into one weighted
+        # pairing — either way they apply in height order
+        if range_justs:
+            s.handle_justifications(range_justs)
         return imported
 
     def _pull_finality(self, host: str, port: int, status: dict) -> None:

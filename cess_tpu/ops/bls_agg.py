@@ -197,6 +197,24 @@ def verify_batch_host(triples: list[SigTriple], seed: bytes = b"") -> bool:
 # ------------------------------------------------------- plain aggregation
 
 
+def aggregate_pubkeys(pks: list[bytes]) -> bytes:
+    """Σ pk_i — the summed verification key (96-byte compressed G2).
+
+    For an aggregate signature over ONE shared message the aggregate
+    equation e(agg, −g2) · Π_K e(H(m), K) == 1 collapses to
+    e(agg, −g2) · e(H(m), Σ pk) == 1, which is exactly the
+    single-signature equation under the summed key — so a whole 2/3
+    finality justification enters the weighted batch check as ONE
+    SigTriple (node/sync.py verify_justifications_batch), and N
+    justifications under the same signer set share one memoized G2
+    decompression inside `_weighted_batch_check`.  Raises ValueError on
+    a malformed key, like G2Point.from_bytes."""
+    acc = G2Point.infinity()
+    for pk in pks:
+        acc = acc + G2Point.from_bytes(pk)
+    return acc.to_bytes()
+
+
 def aggregate_signatures(sigs: list[bytes]) -> bytes:
     """Σ sig_i — the standard BLS aggregate (48-byte compressed G1)."""
     acc = G1Point.infinity()

@@ -119,6 +119,16 @@ def pytest_configure(config):
         "these as their own fast gate, excluded from the main test "
         "run",
     )
+    config.addinivalue_line(
+        "markers",
+        "light: light-client read-plane suite (tests/test_light.py — "
+        "forged/stale justification refusal, era-handoff wrong-set "
+        "refusal, batch-vs-serial justification bit-identity, "
+        "proof-batch tamper matrix, stateless client over real RPC; "
+        "tests/test_zz_light_testnet.py — the validators + replicas + "
+        "load-gen e2e) — CI runs these as their own fast gate, "
+        "excluded from the main test run",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,491 @@
+"""Light-client read plane (cess_tpu/light/): stateless clients +
+keyless replicas.
+
+What this suite pins down:
+
+ * justification batch verification is BIT-IDENTICAL to the serial
+   path over honest + forged mixes, and a replica folds a whole batch
+   into ONE weighted pairing;
+ * the pull surfaces (`chain_getJustification`, `light_syncHeaders`,
+   `state_getProofBatch`) serve exactly what a stateless verifier
+   needs, with the typed refusals (-32004/-32013/-32014) clients key
+   off;
+ * a `LightClient` holding only (genesis, validator keyset) anchors,
+   reads, and re-anchors over REAL RPC against a live keyless replica
+   — and refuses forged justifications, swapped headers, finality
+   rewinds, tampered proofs, and era handoffs to an unprovable
+   validator set.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from cess_tpu.chain import checkpoint, smt
+from cess_tpu.light import LightClient, LightClientError, ReplicaService
+from cess_tpu.light.replica import FinalizedView
+from cess_tpu.node.chain_spec import dev_spec
+from cess_tpu.node.rpc import RpcError, RpcServer, rpc_call
+from cess_tpu.node.service import NodeService
+from cess_tpu.node.sync import (
+    Justification,
+    header_hash,
+    verify_justification,
+    verify_justifications_batch,
+)
+
+pytestmark = pytest.mark.light
+
+
+# ------------------------------------------------------------ harness
+
+
+def make_chain(blocks: int = 6, period: int = 2):
+    """An in-process authoring validator with finality: dev spec is a
+    single-validator chain, so quorum(1, 1) holds and every
+    `_finality_tick` at a period boundary mints a justification."""
+    spec = dev_spec()
+    spec.finality_period = period
+    auth = NodeService(spec)
+    for _ in range(blocks):
+        auth.produce_block()
+        auth._finality_tick()
+    assert auth.finalized_number > 0, "harness must produce finality"
+    return spec, auth
+
+
+def feed_replica(spec, auth) -> ReplicaService:
+    """A keyless replica caught up to the author: blocks via the
+    normal import path, justifications via the batch entry point."""
+    rep = ReplicaService(spec)
+    blocks = [auth.block_by_number[n]
+              for n in range(1, auth.rt.state.block_number + 1)]
+    kinds = [k for k, _ in rep.import_batch(blocks)]
+    assert all(k == "imported" for k in kinds), kinds
+    rep.handle_justifications(
+        [auth.justifications[n] for n in sorted(auth.justifications)])
+    return rep
+
+
+def held_justs(auth) -> list[Justification]:
+    return [auth.justifications[n] for n in sorted(auth.justifications)]
+
+
+@pytest.fixture(scope="module")
+def chain():
+    return make_chain()
+
+
+@pytest.fixture()
+def served_replica(chain):
+    spec, auth = chain
+    rep = feed_replica(spec, auth)
+    srv = RpcServer(rep, port=0)
+    srv.start()
+    yield spec, auth, rep, srv
+    srv.stop()
+
+
+def client_for(spec, srv) -> LightClient:
+    return LightClient.from_spec(spec, host=srv.host, port=srv.port)
+
+
+def tampered(just: Justification, **over) -> Justification:
+    wire = just.to_json()
+    wire.update(over)
+    return Justification.from_json(wire)
+
+
+# -------------------------------------- batch-vs-serial bit-identity
+
+
+def test_batch_verification_bit_identical_to_serial(chain):
+    spec, auth = chain
+    honest = held_justs(auth)
+    assert len(honest) >= 3
+    other_agg = honest[1].agg_sig
+    mix = [
+        honest[0],
+        # aggregate from a DIFFERENT payload: parses as a valid G1
+        # point, fails the pairing
+        tampered(honest[0], agg=other_agg)
+        if honest[0].agg_sig != other_agg else tampered(
+            honest[0], agg=honest[2].agg_sig),
+        honest[1],
+        tampered(honest[1], signers=[]),          # sub-quorum
+        tampered(honest[2], signers=["mallory"]),  # not a validator
+        tampered(honest[2], agg="zz" * 48),        # unparseable sig
+        honest[2],
+    ]
+    validators = list(spec.validators)
+    keys = spec.validator_keys()
+    genesis = spec.genesis_hash()
+    serial = [
+        verify_justification(j, genesis, validators, keys) for j in mix
+    ]
+    assert serial == [True, False, True, False, False, False, True]
+    for seed in (b"", b"replay-a", b"replay-b"):
+        stats = {"pairings": 0}
+        got = verify_justifications_batch(
+            mix, genesis, validators, keys, seed=seed, stats=stats)
+        assert got == serial
+        assert stats["pairings"] >= 1
+
+    # all-honest batch: exactly ONE pairing for the lot
+    stats = {"pairings": 0}
+    assert verify_justifications_batch(
+        honest, genesis, validators, keys, stats=stats
+    ) == [True] * len(honest)
+    assert stats["pairings"] == 1
+
+
+# ----------------------------------------------------- replica tier
+
+
+def test_replica_is_keyless_and_folds_batches(chain):
+    spec, auth = chain
+    rep = feed_replica(spec, auth)
+    assert rep.authority_sk is None  # can never sign, vote, or author
+    assert rep.finalized_number == auth.finalized_number
+    # the whole catch-up range of justifications cost ONE pairing
+    assert rep.m_light_batch.value == 1
+    assert rep.m_light_justs.value == len(auth.justifications)
+    # the read plane tracks the FINALIZED commitment exactly
+    assert rep.read_plane.number == rep.finalized_number
+    fin = rep.block_by_number[rep.finalized_number]
+    assert rep.read_plane.root_hex() == fin.state_hash
+
+
+def test_replica_refuses_forged_in_batch_but_keeps_honest(chain):
+    spec, auth = chain
+    rep = ReplicaService(spec)
+    blocks = [auth.block_by_number[n]
+              for n in range(1, auth.rt.state.block_number + 1)]
+    rep.import_batch(blocks)
+    honest = held_justs(auth)
+    # forge the HIGHEST justification: finality must stop at the
+    # highest honest height, bit-identical to the serial decision
+    forged = tampered(honest[-1], agg=honest[0].agg_sig)
+    rep.handle_justifications(honest[:-1] + [forged])
+    assert rep.finalized_number == honest[-2].number
+    assert rep.read_plane.number == honest[-2].number
+
+
+def test_finalized_view_divergence_is_loud():
+    view = FinalizedView({}, 0)
+    root0 = view.root_hex()
+    delta = [("state", "block_number", None, None,
+              checkpoint.canon_bytes(1))]
+    root1 = view.apply(delta, 1)
+    assert root1 != root0
+    # revert shape: applying the inverse entry restores the root
+    view.apply([("state", "block_number", None,
+                 checkpoint.canon_bytes(1), None)], 2)
+    assert view.root_hex() == root0
+
+
+# ------------------------------------------------------ pull RPCs
+
+
+def test_chain_get_justification_surface(served_replica):
+    spec, auth, rep, srv = served_replica
+    latest = rpc_call(srv.host, srv.port, "chain_getJustification", [None])
+    assert latest["number"] == rep.finalized_number
+    by_num = rpc_call(srv.host, srv.port, "chain_getJustification",
+                      [latest["number"]])
+    assert by_num == latest
+    by_hash = rpc_call(srv.host, srv.port, "chain_getJustification",
+                       [latest["hash"]])
+    assert by_hash == latest
+    with pytest.raises(RpcError) as e:
+        rpc_call(srv.host, srv.port, "chain_getJustification", [999999])
+    assert e.value.code == -32004
+    with pytest.raises(RpcError) as e:
+        rpc_call(srv.host, srv.port, "chain_getJustification", [True])
+    assert e.value.code == -32004  # bool is not a ref
+
+
+def test_light_sync_headers_recompute_hashes(served_replica):
+    spec, auth, rep, srv = served_replica
+    got = rpc_call(srv.host, srv.port, "light_syncHeaders",
+                   [1, auth.rt.state.block_number])
+    assert len(got) == auth.rt.state.block_number
+    genesis = spec.genesis_hash()
+    for n, entry in enumerate(got, start=1):
+        hdr = entry["header"]
+        assert int(hdr["number"]) == n
+        assert header_hash(genesis, hdr) == \
+            auth.block_by_number[n].hash(genesis)
+        just = entry["justification"]
+        if n in auth.justifications:
+            assert just is not None and just["number"] == n
+        else:
+            assert just is None
+
+
+def test_proof_batch_rpc_refusals(served_replica):
+    spec, auth, rep, srv = served_replica
+    serving = rep.read_plane.root_hex()
+    ok = rpc_call(srv.host, srv.port, "state_getProofBatch",
+                  [[["staking", "validators", None]], serving])
+    assert ok["root"] == serving and len(ok["proofs"]) == 1
+    with pytest.raises(RpcError) as e:  # pinned root no longer served
+        rpc_call(srv.host, srv.port, "state_getProofBatch",
+                 [[["staking", "validators", None]], "ab" * 32])
+    assert e.value.code == -32014
+    with pytest.raises(RpcError) as e:  # oversized batch
+        rpc_call(srv.host, srv.port, "state_getProofBatch",
+                 [[["staking", "validators", None]] * 65, None])
+    assert e.value.code == -32013
+    for bad in ([], [["staking"]], "nope",
+                [["state", "balances.accounts", "alice", "extra"]]):
+        with pytest.raises(RpcError) as e:
+            rpc_call(srv.host, srv.port, "state_getProofBatch",
+                     [bad, None])
+        assert e.value.code == -32602
+    with pytest.raises(RpcError) as e:  # keyed map needs its key
+        rpc_call(srv.host, srv.port, "state_getProofBatch",
+                 [[["state", "balances.accounts", None]], None])
+    assert e.value.code == -32602
+
+
+# --------------------------------------------------- light client
+
+
+def test_light_client_statelessly_verifies_over_rpc(served_replica):
+    spec, auth, rep, srv = served_replica
+    lc = client_for(spec, srv)
+    anchor = lc.sync()
+    assert anchor["number"] == rep.finalized_number
+    fin = rep.block_by_number[rep.finalized_number]
+    assert anchor["root"] == fin.state_hash
+    assert lc.justifications_verified == 1
+    present, validators = lc.read("staking", "validators")
+    assert present and validators == spec.validators
+    got = lc.read_batch([
+        ("staking", "validators", None),
+        ("state", "balances.accounts", "alice"),
+        ("state", "balances.accounts", "nobody-ever"),
+    ])
+    assert got[0] == (True, spec.validators)
+    assert got[1][0] is True  # alice funded at genesis
+    assert got[2] == (False, None)  # provable ABSENCE
+    # idempotent re-sync: same anchor, no extra verification work
+    assert lc.sync() == anchor
+    assert lc.justifications_verified == 1
+
+
+def test_light_client_refuses_forged_and_swapped(served_replica):
+    spec, auth, rep, srv = served_replica
+    real = rpc_call(srv.host, srv.port, "chain_getJustification", [None])
+    headers = rpc_call(srv.host, srv.port, "light_syncHeaders",
+                       [real["number"], 1])
+
+    def serve(responses):
+        lc = client_for(spec, srv)
+        orig = lc._call
+
+        def fake(method, *params):
+            if method in responses:
+                return responses[method]
+            return orig(method, *params)
+
+        lc._call = fake
+        return lc
+
+    # forged aggregate: header checks pass, the pairing refuses
+    other = rpc_call(srv.host, srv.port, "chain_getJustification", [2])
+    lc = serve({"chain_getJustification": dict(real, agg=other["agg"])})
+    with pytest.raises(LightClientError, match="refused"):
+        lc.sync()
+    assert lc.anchor is None and lc.justifications_verified == 0
+
+    # swapped header: justification is honest but the served header
+    # does not hash to the justified block
+    wrong_hdr = rpc_call(srv.host, srv.port, "light_syncHeaders", [1, 1])
+    lc = serve({"light_syncHeaders": wrong_hdr})
+    with pytest.raises(LightClientError, match="hash"):
+        lc.sync()
+    assert lc.anchor is None
+
+    # tampered header FIELD: stateHash substitution breaks the hash
+    bad_hdr = {"header": dict(headers[0]["header"], stateHash="00" * 32),
+               "justification": None}
+    lc = serve({"light_syncHeaders": [bad_hdr]})
+    with pytest.raises(LightClientError, match="hash"):
+        lc.sync()
+
+    # finality rewind: a server must never serve an older anchor
+    lc = client_for(spec, srv)
+    lc.sync()
+    lc._call = (lambda orig: lambda mth, *p: (
+        other if mth == "chain_getJustification" else orig(mth, *p)
+    ))(lc._call)
+    with pytest.raises(LightClientError, match="behind"):
+        lc.sync()
+
+
+def test_light_client_proof_tamper_matrix(served_replica):
+    spec, auth, rep, srv = served_replica
+    reads = [("staking", "validators", None),
+             ("state", "balances.accounts", "alice")]
+    wire = rpc_call(
+        srv.host, srv.port, "state_getProofBatch",
+        [[list(r) for r in reads], None])
+    root = wire["root"]
+
+    # direct verifier: every tampering class must raise ProofError
+    def proofs():
+        return [dict(p) for p in wire["proofs"]]
+
+    honest = checkpoint.verify_read_batch(
+        root, reads, [p["proof"] for p in wire["proofs"]])
+    assert [ok for ok, _ in honest] == [True, True]
+
+    cases = []
+    p = proofs()  # swapped proofs between reads
+    cases.append([p[1]["proof"], p[0]["proof"]])
+    p = proofs()  # flipped sibling byte
+    sib = dict(p[0]["proof"])
+    sib["siblings"] = list(sib["siblings"])
+    first = sib["siblings"][0]
+    sib["siblings"][0] = ("00" if first[:2] != "00" else "ff") + first[2:]
+    cases.append([sib, p[1]["proof"]])
+    p = proofs()  # substituted leaf value
+    val = dict(p[1]["proof"])
+    val["leafValue"] = checkpoint.canon_bytes(
+        {"free": 10**12, "reserved": 0}).hex()
+    cases.append([p[0]["proof"], val])
+    p = proofs()  # truncated audit path
+    trunc = dict(p[0]["proof"])
+    trunc["siblings"] = list(trunc["siblings"])[:-1]
+    cases.append([trunc, p[1]["proof"]])
+    for tampered_pair in cases:
+        with pytest.raises(smt.ProofError):
+            checkpoint.verify_read_batch(root, reads, tampered_pair)
+    with pytest.raises(smt.ProofError):  # wrong root entirely
+        checkpoint.verify_read_batch(
+            "ab" * 32, reads, [p["proof"] for p in proofs()])
+
+    # client-level: a replica serving a tampered wire is refused even
+    # though it claims the right root
+    lc = client_for(spec, srv)
+    lc.sync()
+    orig = lc._call
+
+    def tamper(method, *params):
+        got = orig(method, *params)
+        if method == "state_getProofBatch":
+            bad = dict(got["proofs"][0]["proof"])
+            bad["leafValue"] = checkpoint.canon_bytes(
+                ["mallory"]).hex()
+            got["proofs"][0] = dict(got["proofs"][0], proof=bad)
+        return got
+
+    lc._call = tamper
+    with pytest.raises(LightClientError):
+        lc.read("staking", "validators")
+
+
+def test_light_client_reanchors_on_root_mismatch():
+    spec, auth = make_chain(blocks=4)
+    rep = feed_replica(spec, auth)
+    srv = RpcServer(rep, port=0)
+    srv.start()
+    try:
+        lc = client_for(spec, srv)
+        first = dict(lc.sync())
+        # the chain moves on; the replica finalizes past the anchor
+        for _ in range(4):
+            auth.produce_block()
+            auth._finality_tick()
+        rep.import_batch(
+            [auth.block_by_number[n]
+             for n in range(first["number"] + 1,
+                            auth.rt.state.block_number + 1)])
+        rep.handle_justifications(held_justs(auth))
+        assert rep.finalized_number > first["number"]
+        # the pinned old root gets -32014; the client re-anchors on a
+        # NEW verified justification and the read still verifies
+        present, validators = lc.read("staking", "validators")
+        assert present and validators == spec.validators
+        assert lc.anchor["number"] == rep.finalized_number
+        assert lc.justifications_verified == 2
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------------------ era handoff
+
+
+def test_era_handoff_refuses_unprovable_validator():
+    spec, auth = make_chain(blocks=2)
+    # the NEXT state names a validator that has no provable session
+    # key and is outside the client's tracked set
+    auth.rt.staking.validators = ["alice", "mallory"]
+    # two blocks so the justified target lands back on the head: a
+    # validator (unlike a replica) proves against its HEAD state
+    auth.produce_block()
+    auth.produce_block()
+    auth._finality_tick()
+    assert auth.finalized_number == auth.rt.state.block_number
+    srv = RpcServer(auth, port=0)
+    srv.start()
+    try:
+        lc = client_for(spec, srv)
+        with pytest.raises(LightClientError, match="mallory"):
+            lc.sync()
+        assert lc.anchor is None  # nothing adopted on the refusal path
+        assert lc.keys == spec.validator_keys()
+    finally:
+        srv.stop()
+
+
+def test_era_handoff_adopts_proven_set():
+    spec, auth = make_chain(blocks=2)
+    bob_key = b"\x42" * 96
+    auth.rt.session.keys["bob"] = bob_key  # provable registration
+    auth.rt.staking.validators = ["alice", "bob"]
+    auth.produce_block()
+    auth.produce_block()
+    auth._finality_tick()
+    assert auth.finalized_number == auth.rt.state.block_number
+    srv = RpcServer(auth, port=0)
+    srv.start()
+    try:
+        lc = client_for(spec, srv)
+        anchor = lc.sync()
+        assert anchor["number"] == auth.finalized_number
+        assert lc.handoffs == 1
+        assert lc.keys == {
+            "alice": spec.validator_keys()["alice"], "bob": bob_key,
+        }
+    finally:
+        srv.stop()
+
+
+def test_era_handoff_wrong_key_breaks_future_verification():
+    """A handoff that SUCCEEDS with a garbage key is not a trust leak:
+    a justification signed by the real key no longer verifies against
+    the adopted garbage set, so finality stops rather than lies."""
+    spec, auth = make_chain(blocks=2)
+    auth.rt.session.keys["alice"] = b"\x13" * 96  # overrides alice's key
+    auth.produce_block()
+    auth.produce_block()
+    auth._finality_tick()
+    n_before = auth.finalized_number
+    assert n_before == auth.rt.state.block_number
+    srv = RpcServer(auth, port=0)
+    srv.start()
+    try:
+        lc = client_for(spec, srv)
+        lc.sync()  # adopts {alice: garbage} — provable, just wrong
+        assert lc.handoffs == 1
+        auth.produce_block()
+        auth.produce_block()
+        auth._finality_tick()
+        assert auth.finalized_number > n_before
+        with pytest.raises(LightClientError, match="refused"):
+            lc.sync()
+    finally:
+        srv.stop()

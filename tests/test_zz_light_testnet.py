@@ -1,0 +1,215 @@
+"""Acceptance e2e for the read plane: a CLI-launched testnet of 3
+validators + 2 KEYLESS read replicas (`--replica`), with stateless
+light clients doing verified reads against the replicas only.
+
+What must hold over the real wire:
+
+ * replicas follow the validator set (blocks + finality) without ever
+   authoring, voting, or holding a key;
+ * a `LightClient` holding only (genesis hash, validator keyset)
+   anchors on a pulled justification it verifies itself and reads
+   state it proves against its OWN justified root;
+ * the load generator (tools/read_loadgen.py) pushes a client fleet
+   across BOTH replicas with zero verification errors;
+ * `python -m cess_tpu proof --light` closes the loop end to end from
+   a fresh process;
+ * the replica exposes the read-plane metric families.
+
+Sorts last (zz) so a gate timeout truncates it, not the broad suite.
+"""
+
+import json
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from cess_tpu.node.chain_spec import _spec, load_spec
+from cess_tpu.node.rpc import RpcError, rpc_call
+
+pytestmark = pytest.mark.light
+
+BLOCK_MS = 500
+HOST = "127.0.0.1"
+VALIDATORS = ["alice", "bob", "charlie"]
+
+
+def free_ports(n: int) -> list[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind((HOST, 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def build_spec_file(tmp_path) -> str:
+    spec = _spec(
+        "light-e2e", "CESS-TPU Light E2E",
+        accounts=VALIDATORS,
+        validators=VALIDATORS,
+        block_time_ms=BLOCK_MS,
+    )
+    spec.finality_period = 4
+    path = tmp_path / "light-e2e-spec.json"
+    path.write_text(spec.to_json())
+    return str(path)
+
+
+def launch(spec_path: str, port: int, peer_ports: list[int],
+           authority: str | None = None) -> subprocess.Popen:
+    peers = ",".join(f"{HOST}:{p}" for p in peer_ports)
+    cmd = [sys.executable, "-m", "cess_tpu", "run",
+           "--chain", spec_path, "--rpc-port", str(port),
+           "--peers", peers, "--checkpoint-gap", "3"]
+    cmd += (["--authority", authority] if authority else ["--replica"])
+    return subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        cwd="/root/repo", text=True,
+    )
+
+
+def wait_rpc(port: int, timeout: float = 120.0) -> None:
+    t0 = time.monotonic()
+    while True:
+        try:
+            rpc_call(HOST, port, "system_name", [], timeout=2.0)
+            return
+        except (OSError, RpcError):
+            if time.monotonic() - t0 > timeout:
+                raise TimeoutError(f"node on port {port} never came up")
+            time.sleep(0.5)
+
+
+def status(port: int) -> dict:
+    return rpc_call(HOST, port, "sync_status", [], timeout=5.0)
+
+
+def wait_for(pred, timeout: float, what: str, poll: float = 0.4):
+    t0 = time.monotonic()
+    while True:
+        value = pred()
+        if value:
+            return value
+        if time.monotonic() - t0 > timeout:
+            raise TimeoutError(f"timed out waiting for {what}")
+        time.sleep(poll)
+
+
+class TestLightReadPlane:
+    def test_replicas_and_light_clients(self, tmp_path):
+        spec_path = build_spec_file(tmp_path)
+        spec = load_spec(spec_path)
+        # one allocation for all five: two separate free_ports calls
+        # can hand the second batch a port from the first (the sockets
+        # are closed by then), and a silent bind collision kills a node
+        ports = free_ports(5)
+        vports, rports = ports[:3], ports[3:]
+        procs = {}
+        try:
+            for v, port in zip(VALIDATORS, vports):
+                procs[v] = launch(
+                    spec_path, port,
+                    [p for p in vports if p != port], authority=v)
+            # replicas peer with the validators only (the read tier
+            # hangs OFF the consensus tier, it is not part of it)
+            for i, port in enumerate(rports):
+                procs[f"replica-{i}"] = launch(spec_path, port, vports)
+            for port in vports + rports:
+                wait_rpc(port)
+
+            # ---- replicas follow: blocks AND finality arrive over
+            # sync, verified in justification batches
+            wait_for(
+                lambda: min(status(p)["number"] for p in vports) >= 2,
+                120, "validators past block 2",
+            )
+            wait_for(
+                lambda: min(
+                    status(p)["finalized"]["number"] for p in rports
+                ) >= 4,
+                150, "both replicas finalized >= 4", poll=1.0,
+            )
+
+            # ---- keyless: a replica NEVER authors
+            for p in rports:
+                metrics = rpc_call(HOST, p, "system_metrics", [],
+                                   timeout=5.0)
+                assert "cess_blocks_produced 0" in metrics
+                for family in ("cess_replica_reads_total",
+                               "cess_light_justifications_verified",
+                               "cess_light_batch_pairings",
+                               "cess_replica_proof_seconds"):
+                    assert family in metrics
+
+            # ---- a stateless client verifies against replica 0 only
+            from cess_tpu.light import LightClient
+
+            lc = LightClient.from_spec(spec, HOST, rports[0],
+                                       timeout=15.0)
+            anchor = lc.sync()
+            assert anchor["number"] >= 4
+            got = lc.read_batch([
+                ("staking", "validators", None),
+                ("state", "balances.accounts", "alice"),
+                ("state", "balances.accounts", "nobody"),
+            ])
+            assert got[0] == (True, VALIDATORS)
+            assert got[1][0] is True
+            assert got[2] == (False, None)
+            # the justification the anchor rests on carries a REAL 2/3
+            # quorum of the 3 validators
+            just = rpc_call(HOST, rports[0], "chain_getJustification",
+                            [anchor["number"]], timeout=5.0)
+            assert len(just["signers"]) * 3 >= 2 * len(VALIDATORS)
+
+            # ---- client fleet across BOTH replicas, zero verification
+            # errors (tools/read_loadgen.py — every read is proven)
+            sys.path.insert(0, "/root/repo")
+            from tools.read_loadgen import run_load
+
+            load = run_load(
+                [(HOST, rports[0]), (HOST, rports[1])], spec,
+                clients=4, reads=8, timeout=15.0)
+            assert load["errors"] == 0
+            assert load["reads"] == 4 * 8
+            assert load["verified_leaves"] > 0
+
+            # the replicas, not the validators, absorbed the reads
+            for p in rports:
+                metrics = rpc_call(HOST, p, "system_metrics", [],
+                                   timeout=5.0)
+                line = next(
+                    ln for ln in metrics.splitlines()
+                    if ln.startswith("cess_replica_reads_total"))
+                assert float(line.split()[-1]) > 0
+
+            # ---- CLI end to end from a fresh process: the printed
+            # root is JUSTIFIED, not trusted
+            out = subprocess.run(
+                [sys.executable, "-m", "cess_tpu", "proof", "--light",
+                 "--chain", spec_path, "--rpc", f"{HOST}:{rports[1]}",
+                 "state", "balances.accounts", '"alice"'],
+                capture_output=True, text=True, timeout=120,
+                cwd="/root/repo",
+            )
+            assert out.returncode == 0, out.stderr
+            report = json.loads(out.stdout)
+            assert report["rootSource"] == "justified (light client)"
+            assert report["present"] is True
+            assert report["justificationsVerified"] == 1
+            assert report["anchor"]["number"] % 4 == 0
+        finally:
+            for proc in procs.values():
+                if proc.poll() is None:
+                    proc.kill()
+            for proc in procs.values():
+                try:
+                    proc.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    pass

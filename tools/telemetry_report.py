@@ -243,6 +243,29 @@ class FleetCollector:
                     "feeTotal": fams.get(
                         "cess_pool_fee_total", m.MetricFamily("")).value(),
                 }
+                # read-plane families (light/replica.py): present only
+                # on read replicas — reads served, proof build latency,
+                # and the justification-batch amortisation (verified
+                # per weighted pairing; >1 means batching is paying)
+                if "cess_replica_reads_total" in fams:
+                    verified = fams.get(
+                        "cess_light_justifications_verified",
+                        m.MetricFamily("")).value()
+                    pairings = fams.get(
+                        "cess_light_batch_pairings",
+                        m.MetricFamily("")).value()
+                    entry["readPlane"] = {
+                        "reads": fams["cess_replica_reads_total"].value(),
+                        "proofLatency": (
+                            histogram_summary(
+                                fams["cess_replica_proof_seconds"])
+                            if "cess_replica_proof_seconds" in fams
+                            else None),
+                        "justificationsVerified": verified,
+                        "batchPairings": pairings,
+                        "justsPerPairing": round(
+                            verified / pairings, 2) if pairings else 0.0,
+                    }
             per_node[label] = entry
 
         # fleet rates: the chain advances as one, so blocks/s is the
@@ -362,6 +385,13 @@ class FleetCollector:
                 "spam_drop_rate": round(
                     rejections_total
                     / max(1.0, rejections_total + applied_total), 4),
+                "replica_reads_total": sum(
+                    e.get("readPlane", {}).get("reads", 0.0)
+                    for e in per_node.values()
+                ),
+                "replicas": sum(
+                    1 for e in per_node.values() if "readPlane" in e
+                ),
             },
             "per_node": per_node,
             "proof": proof,
@@ -438,6 +468,33 @@ def to_markdown(report: dict) -> str:
                     f"| {stage} | {s['count']} | {s['mean_ms']} "
                     f"| {s['p50_ms']} | {s['p95_ms']} |"
                 )
+        lines.append("")
+    replicas = {
+        label: entry["readPlane"]
+        for label, entry in report["per_node"].items()
+        if entry.get("readPlane")
+    }
+    if replicas:
+        lines += [
+            "## Read plane",
+            "",
+            f"{report['fleet'].get('replicas', 0)} replica(s) served "
+            f"{int(report['fleet'].get('replica_reads_total', 0))} "
+            "verified read proofs.",
+            "",
+            "| replica | reads | proof p50 ms | proof p95 ms "
+            "| justs verified | pairings | justs/pairing |",
+            "|---|---|---|---|---|---|---|",
+        ]
+        for label, rp in replicas.items():
+            lat = rp.get("proofLatency") or {}
+            lines.append(
+                f"| {label} | {int(rp['reads'])} "
+                f"| {lat.get('p50_ms', 0)} | {lat.get('p95_ms', 0)} "
+                f"| {int(rp['justificationsVerified'])} "
+                f"| {int(rp['batchPairings'])} "
+                f"| {rp['justsPerPairing']} |"
+            )
         lines.append("")
     proof = report.get("proof") or {}
     if proof:
